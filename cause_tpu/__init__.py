@@ -1,0 +1,185 @@
+"""cause_tpu — a TPU-native causal-tree CRDT framework.
+
+The flat public API, mirroring the reference facade
+(reference: src/causal/core.cljc:15-53). Everything a user needs lives
+here: the CausalBase database, CausalList / CausalMap collection types,
+node construction, insert/append/weft/merge, materialization, and the
+special values.
+
+The one framework flag is the weave backend: pass ``weaver="jax"`` to
+``base`` / ``clist`` / ``cmap`` to run full reweaves and merges as
+batched XLA programs on TPU; the pure host weaver is the default and
+the semantics oracle.
+"""
+
+from __future__ import annotations
+
+from .cbase import (
+    CausalBase,
+    Ref,
+    is_ref,
+    new_causal_base,
+    uuid_to_ref,
+)
+from .collections.clist import CausalList, new_causal_list
+from .collections.cmap import CausalMap, new_causal_map
+from .collections.shared import CausalError, CausalTree
+from .ids import (
+    H_HIDE,
+    H_SHOW,
+    HIDE,
+    K,
+    Keyword,
+    ROOT_ID,
+    SPECIALS,
+    is_special,
+    new_site_id,
+    new_uid,
+    node,
+)
+
+__version__ = "0.1.0"
+
+# Special values have special effects on causal collections.
+# NOTE: specials do not compose — applying hide to a hide is not a show
+# (reference: core.cljc:13-14).
+hide = HIDE
+h_hide = H_HIDE
+h_show = H_SHOW
+
+# The id of the first node in every causal list; insert at the front by
+# using root_id as the cause (core.cljc:16-18).
+root_id = ROOT_ID
+
+# Causal base. This is what you want 99% of the time (core.cljc:21-28).
+base = new_causal_base
+
+
+def transact(causal_base, tx):
+    """Apply one or many changes at the current logical time
+    (protocols.cljc:38-39)."""
+    return causal_base.transact(tx)
+
+
+def undo(causal_base):
+    """Undo a transaction by the local site-id (protocols.cljc:43-44)."""
+    return causal_base.undo()
+
+
+def redo(causal_base):
+    """Redo a transaction by the local site-id (protocols.cljc:45-46)."""
+    return causal_base.redo()
+
+
+def get_collection(causal_base, ref_or_uuid=None):
+    """The collection for a ref/uuid, or the root collection
+    (protocols.cljc:40-42)."""
+    return causal_base.get_collection(ref_or_uuid)
+
+
+def set_site_id(causal_base, site_id):
+    """Set the local site-id (protocols.cljc:47-48)."""
+    return causal_base.set_site_id(site_id)
+
+
+# Causal meta attributes (core.cljc:33-35).
+def get_uuid(causal):
+    return causal.get_uuid()
+
+
+def get_ts(causal):
+    return causal.get_ts()
+
+
+def get_site_id(causal):
+    return causal.get_site_id()
+
+
+# Causal collection types are convergent and EDN-like (core.cljc:41-42).
+clist = new_causal_list
+cmap = new_causal_map
+
+
+# Causal collection functions (core.cljc:45-50).
+def insert(causal, node, more_nodes_in_tx=None):
+    """Insert a node in the causal collection (protocols.cljc:20-21)."""
+    return causal.insert(node, more_nodes_in_tx)
+
+
+def append(causal, cause, value):
+    """Create and insert a node at the current lamport timestamp
+    (protocols.cljc:22-24)."""
+    return causal.append(cause, value)
+
+
+def weft(causal, ids_to_cut_yarns):
+    """Cut each yarn at an id and rebuild the collection at a previous
+    point in time (protocols.cljc:25-27)."""
+    return causal.weft(ids_to_cut_yarns)
+
+
+def merge(causal1, causal2):
+    """Merge two causal collections of the same type and uuid
+    (protocols.cljc:28-31)."""
+    return causal1.merge(causal2)
+
+
+def get_weave(causal):
+    """The woven cache of nodes (protocols.cljc:14-15)."""
+    return causal.get_weave()
+
+
+def get_nodes(causal):
+    """The canonical {id: (cause, value)} store (protocols.cljc:16-17)."""
+    return causal.get_nodes()
+
+
+# Causal conversion (core.cljc:53).
+from .collections.shared import causal_to_edn  # noqa: E402
+
+__all__ = [
+    "CausalBase",
+    "CausalError",
+    "CausalList",
+    "CausalMap",
+    "CausalTree",
+    "K",
+    "Keyword",
+    "Ref",
+    "HIDE",
+    "H_HIDE",
+    "H_SHOW",
+    "SPECIALS",
+    "ROOT_ID",
+    "hide",
+    "h_hide",
+    "h_show",
+    "root_id",
+    "base",
+    "transact",
+    "undo",
+    "redo",
+    "is_ref",
+    "uuid_to_ref",
+    "get_collection",
+    "set_site_id",
+    "get_uuid",
+    "get_ts",
+    "get_site_id",
+    "node",
+    "clist",
+    "cmap",
+    "new_causal_list",
+    "new_causal_map",
+    "new_causal_base",
+    "insert",
+    "append",
+    "weft",
+    "merge",
+    "get_weave",
+    "get_nodes",
+    "causal_to_edn",
+    "is_special",
+    "new_uid",
+    "new_site_id",
+]

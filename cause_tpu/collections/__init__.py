@@ -1,0 +1,4 @@
+"""Causal collection types: the shared causal-tree core plus the
+CausalList and CausalMap types (reference: src/causal/collections/)."""
+
+from . import shared  # noqa: F401

@@ -1,0 +1,224 @@
+"""CausalList — a sequence CRDT (RGA-style causal tree).
+
+Port of reference src/causal/collections/list.cljc: causes are
+predecessor ids, the weave is a flat list of nodes, and rendering skips
+specials, tombstoned nodes and the root. Python container protocols
+mirror the reference's Clojure interop: ``len`` counts *active values*
+(list.cljc:76-77) while iteration yields the visible *nodes* themselves
+(list.cljc:94-95) — "seq returns nodes, count counts values".
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..ids import (
+    HIDE,
+    H_HIDE,
+    ROOT_ID,
+    ROOT_NODE,
+    is_special,
+    new_site_id,
+    new_uid,
+    node_from_kv,
+)
+from ..weaver import pure
+from . import shared as s
+from .shared import CausalTree
+
+__all__ = [
+    "new_causal_tree",
+    "weave",
+    "hide_q",
+    "causal_list_to_edn",
+    "causal_list_to_list",
+    "CausalList",
+    "new_causal_list",
+]
+
+
+def new_causal_tree(weaver: str = "pure") -> CausalTree:
+    """A fresh list tree seeded with the root sentinel in all three
+    stores (list.cljc:11-18)."""
+    return CausalTree(
+        type=s.LIST_TYPE,
+        lamport_ts=0,
+        uuid=new_uid(),
+        site_id=new_site_id(),
+        nodes={ROOT_ID: (None, None)},
+        yarns={"0": [ROOT_NODE]},
+        weave=[ROOT_NODE],
+        weaver=weaver,
+    )
+
+
+def weave(ct: CausalTree, node=None, more_consecutive_nodes_in_same_tx=None) -> CausalTree:
+    """The list weave function (list.cljc:20-34).
+
+    Full rebuild (no node): fold every node, in sorted id order, through
+    the sequential weave — O(n^2) on the host, or one batched device
+    linearization when the tree's weaver is "jax". Incremental (node
+    given): O(n) single scan; a run of same-tx nodes is spliced in the
+    same pass.
+    """
+    if node is None:
+        if ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return jaxw.refresh_list_weave(ct)
+        w = []
+        for nid in sorted(ct.nodes):
+            w = pure.weave_node(w, node_from_kv((nid, ct.nodes[nid])))
+        return ct.evolve(weave=w)
+    if node[0] not in ct.nodes:
+        return ct
+    return ct.evolve(
+        weave=pure.weave_node(ct.weave, node, more_consecutive_nodes_in_same_tx)
+    )
+
+
+def conj_(ct: CausalTree, *values) -> CausalTree:
+    """Append value(s) after the last node of the current weave
+    (list.cljc:36-40)."""
+    for v in values:
+        ct = s.append(weave, ct, ct.weave[-1][0], v)
+    return ct
+
+
+def cons_(v, ct: CausalTree) -> CausalTree:
+    """Insert a value at the front (cause = root, list.cljc:42-43)."""
+    return s.append(weave, ct, ROOT_ID, v)
+
+
+def empty_(ct: CausalTree) -> CausalTree:
+    """A fresh tree preserving identity (site-id, uuid, weaver)
+    (list.cljc:45-46)."""
+    return new_causal_tree(ct.weaver).evolve(site_id=ct.site_id, uuid=ct.uuid)
+
+
+def hide_q(node, next_node_in_weave) -> bool:
+    """Is this node hidden when the weave is rendered? (list.cljc:48-55)
+    Hidden iff it is a special, or the next weave node is a hide/h.hide
+    targeting it, or it is the root."""
+    if is_special(node[2]):
+        return True
+    nr = next_node_in_weave
+    if nr is not None and (nr[2] is HIDE or nr[2] is H_HIDE) and node[0] == nr[1]:
+        return True
+    return node == ROOT_NODE
+
+
+def causal_list_to_edn(ct: CausalTree, opts: Optional[dict] = None) -> list:
+    """Materialize the current state as a plain list (list.cljc:57-66):
+    pairwise scan over the weave keeping visible values."""
+    w = ct.weave
+    out = []
+    for i, n in enumerate(w):
+        nr = w[i + 1] if i + 1 < len(w) else None
+        if not hide_q(n, nr):
+            out.append(s.causal_to_edn(n[2], opts))
+    return out
+
+
+def causal_list_to_list(ct: CausalTree) -> list:
+    """The visible *nodes* in weave order (list.cljc:68-72)."""
+    w = ct.weave
+    out = []
+    for i, n in enumerate(w):
+        nr = w[i + 1] if i + 1 < len(w) else None
+        if not hide_q(n, nr):
+            out.append(n)
+    return out
+
+
+class CausalList:
+    """Immutable CausalList handle (list.cljc:74-178).
+
+    ``len`` counts active values; iteration yields visible nodes.
+    All mutating-looking methods return a new CausalList.
+    """
+
+    __slots__ = ("ct",)
+
+    def __init__(self, ct: CausalTree):
+        object.__setattr__(self, "ct", ct)
+
+    def __setattr__(self, *a):
+        raise AttributeError("CausalList is immutable")
+
+    # -- CausalMeta (protocols.cljc:3-10) --
+    def get_uuid(self) -> str:
+        return self.ct.uuid
+
+    def get_ts(self) -> int:
+        return self.ct.lamport_ts
+
+    def get_site_id(self) -> str:
+        return self.ct.site_id
+
+    # -- CausalTree protocol (protocols.cljc:12-31) --
+    def get_weave(self):
+        return self.ct.weave
+
+    def get_nodes(self):
+        return self.ct.nodes
+
+    def insert(self, node, more_nodes=None) -> "CausalList":
+        return CausalList(s.insert(weave, self.ct, node, more_nodes))
+
+    def append(self, cause, value) -> "CausalList":
+        return CausalList(s.append(weave, self.ct, cause, value))
+
+    def weft(self, ids_to_cut_yarns) -> "CausalList":
+        return CausalList(
+            s.weft(weave, lambda: new_causal_tree(self.ct.weaver), self.ct,
+                   ids_to_cut_yarns)
+        )
+
+    def merge(self, other: "CausalList") -> "CausalList":
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return CausalList(jaxw.merge_list_trees(self.ct, other.ct))
+        return CausalList(s.merge_trees(weave, self.ct, other.ct))
+
+    # -- CausalTo (protocols.cljc:33-35) --
+    def causal_to_edn(self, opts: Optional[dict] = None) -> list:
+        return causal_list_to_edn(self.ct, opts)
+
+    # -- Python container interop (mirrors list.cljc:74-135) --
+    def conj(self, *values) -> "CausalList":
+        return CausalList(conj_(self.ct, *values))
+
+    def cons(self, value) -> "CausalList":
+        return CausalList(cons_(value, self.ct))
+
+    def empty(self) -> "CausalList":
+        return CausalList(empty_(self.ct))
+
+    def __len__(self) -> int:
+        return len(causal_list_to_edn(self.ct))
+
+    def __iter__(self):
+        return iter(causal_list_to_list(self.ct))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, CausalList) and self.ct == other.ct
+
+    def __hash__(self) -> int:
+        return hash((self.ct.uuid, self.ct.lamport_ts, self.ct.site_id,
+                     tuple(sorted(self.ct.nodes))))
+
+    def __repr__(self) -> str:
+        return f"#causal/list {causal_list_to_edn(self.ct)!r}"
+
+    def __str__(self) -> str:
+        return str(causal_list_to_list(self.ct))
+
+
+def new_causal_list(*items, weaver: str = "pure") -> CausalList:
+    """Create a new causal list containing the items (list.cljc:175-178)."""
+    cl = CausalList(new_causal_tree(weaver))
+    if items:
+        cl = cl.conj(*items)
+    return cl

@@ -1,0 +1,276 @@
+"""Causal-tree core: tree shape, insert/append, yarn cache, weft, merge.
+
+The cause_tpu equivalent of the reference's generic CRDT core
+(reference: src/causal/collections/shared.cljc). A causal tree holds:
+
+- ``nodes`` — canonical append-only store ``{id: (cause, value)}``
+  (shared.cljc:9,62);
+- ``yarns`` — CACHE: per-site, time-sorted list of nodes
+  (shared.cljc:10,64-65), kept so weft (time travel) is fast;
+- ``weave`` — CACHE: the linearized output order; a list of nodes for
+  list trees (shared.cljc:67) or a ``{key: list-weave}`` dict for map
+  trees (shared.cljc:68).
+
+Caches are disposable: ``refresh_caches`` rebuilds yarns, lamport-ts and
+the weave from ``nodes`` alone (shared.cljc:259-266) — a tree can always
+be reconstituted from a bag of nodes.
+
+All operations are functional: they return a new ``CausalTree`` value and
+never mutate their input (copy-on-write per call, mirroring the
+reference's persistent maps). The host-side structures stay O(n)-per-op
+like the reference; bulk/batched work belongs to the device weaver.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import util as u
+from ..ids import (
+    ROOT_ID,
+    is_key,
+    is_special,
+    new_site_id,
+    new_uid,
+    node_from_kv,
+    get_tx,
+)
+from ..weaver import pure
+
+__all__ = [
+    "CausalTree",
+    "CausalError",
+    "assoc_nodes",
+    "spin",
+    "insert",
+    "append",
+    "refresh_ts",
+    "yarns_to_nodes",
+    "refresh_caches",
+    "weft",
+    "merge_trees",
+    "causal_to_edn",
+]
+
+LIST_TYPE = "list"
+MAP_TYPE = "map"
+
+
+class CausalError(Exception):
+    """Validation failure in a causal operation. Carries an info dict like
+    the reference's ``ex-info`` (e.g. shared.cljc:163-181)."""
+
+    def __init__(self, message: str, info: Optional[dict] = None):
+        super().__init__(message)
+        self.info = info or {}
+
+
+@dataclass(frozen=True)
+class CausalTree:
+    """One causal tree (shared.cljc:72-73). Treat as immutable; all ops
+    return a new tree. ``weaver`` selects the weave backend: "pure"
+    (host scan, default) or "jax" (device kernel for full rebuilds and
+    merges) — the framework's one real flag."""
+
+    type: str
+    lamport_ts: int
+    uuid: str
+    site_id: str
+    nodes: Dict[tuple, tuple]
+    yarns: Dict[str, list]
+    weave: Any
+    weaver: str = "pure"
+
+    def evolve(self, **kw) -> "CausalTree":
+        return replace(self, **kw)
+
+
+WeaveFn = Callable[..., CausalTree]
+
+
+def assoc_nodes(ct: CausalTree, nodes) -> CausalTree:
+    """Add node triples to the canonical ``nodes`` store
+    (shared.cljc:104-110)."""
+    store = dict(ct.nodes)
+    for n in nodes:
+        store[n[0]] = (n[1], n[2])
+    return ct.evolve(nodes=store)
+
+
+def _spin_one(yarns: Dict[str, list], n) -> None:
+    """Place one node into its site's time-sorted yarn, mutating the
+    (freshly copied) yarns dict (shared.cljc:112-119)."""
+    site = n[0][1]
+    yarn = yarns.get(site)
+    if yarn is None:
+        yarns[site] = [n]
+    elif yarn[-1][0] < n[0]:
+        yarns[site] = yarn + [n]
+    else:
+        # expensive sorted splice; avoided on the append fast path above
+        yarns[site] = u.insert_sorted(yarn, n)
+
+
+def spin(ct: CausalTree, node=None, more_nodes=None) -> CausalTree:
+    """Maintain the yarn cache (shared.cljc:121-149).
+
+    With no node, rebuild every yarn from the canonical store in sorted
+    id order. With a node (and optional same-tx run), place just those.
+    The reference intends a bulk fast path for sequential list
+    transactions (shared.cljc:137-143) but its guard never fires; we spin
+    one node at a time, which is the behavior it actually exhibits (the
+    per-site append fast path keeps the common case O(1)).
+    """
+    yarns = dict(ct.yarns)
+    if node is None:
+        yarns = {}
+        for nid in sorted(ct.nodes):
+            _spin_one(yarns, node_from_kv((nid, ct.nodes[nid])))
+    else:
+        _spin_one(yarns, node)
+        if more_nodes:
+            for n in more_nodes:
+                _spin_one(yarns, n)
+    return ct.evolve(yarns=yarns)
+
+
+def insert(weave_fn: WeaveFn, ct: CausalTree, node, more_nodes_in_tx=None) -> CausalTree:
+    """Insert an arbitrary node from any site and any point in time
+    (shared.cljc:151-184). Validations:
+
+    - all nodes in one call must belong to the same transaction;
+    - re-inserting an identical node is an idempotent no-op; inserting a
+      *different* body under an existing id raises (append-only store);
+    - an id-valued cause must already exist in the tree;
+    - the local lamport-ts fast-forwards to the node's ts if greater.
+    """
+    nodes = [node]
+    if more_nodes_in_tx:
+        nodes.extend(more_nodes_in_tx)
+    txs = {get_tx(n) for n in nodes}
+    if len(txs) > 1:
+        raise CausalError("All nodes must belong to the same tx.", {"txs": txs})
+    existing = ct.nodes.get(node[0])
+    if existing is not None:
+        if existing == (node[1], node[2]):
+            return ct  # idempotency!
+        raise CausalError(
+            "This node is already in the tree and can't be changed.",
+            {"causes": {"append-only", "edits-not-allowed"},
+             "existing_node": (node[0],) + existing},
+        )
+    if not is_key(node[1]) and node[1] not in ct.nodes:
+        raise CausalError(
+            "The cause of this node is not in the tree.",
+            {"causes": {"cause-must-exist"}},
+        )
+    if node[0][0] > ct.lamport_ts:
+        ct = ct.evolve(lamport_ts=node[0][0])
+    ct = assoc_nodes(ct, nodes)
+    ct = spin(ct, node, more_nodes_in_tx)
+    return weave_fn(ct, node, more_nodes_in_tx)
+
+
+def append(weave_fn: WeaveFn, ct: CausalTree, cause, value) -> CausalTree:
+    """Mint a node at the next local lamport-ts and insert it
+    (shared.cljc:186-192)."""
+    ct2 = ct.evolve(lamport_ts=ct.lamport_ts + 1)
+    n = ((ct2.lamport_ts, ct2.site_id, 0), cause, value)
+    return insert(weave_fn, ct2, n)
+
+
+def refresh_ts(ct: CausalTree) -> CausalTree:
+    """Set lamport-ts to the max ts in the (up-to-date, sorted) yarns
+    (shared.cljc:243-249)."""
+    ts = 0
+    for yarn in ct.yarns.values():
+        if yarn:
+            ts = max(ts, yarn[-1][0][0])
+    return ct.evolve(lamport_ts=ts)
+
+
+def yarns_to_nodes(ct: CausalTree) -> CausalTree:
+    """Rebuild the canonical store from the yarns (shared.cljc:251-257)."""
+    store = {}
+    for yarn in ct.yarns.values():
+        for n in yarn:
+            store[n[0]] = (n[1], n[2])
+    return ct.evolve(nodes=store)
+
+
+def refresh_caches(weave_fn: WeaveFn, ct: CausalTree) -> CausalTree:
+    """Rebuild yarns, lamport-ts and the weave from ``nodes`` alone
+    (shared.cljc:259-266). The idempotency oracle of the test suite:
+    an incrementally-maintained tree must equal its refreshed self."""
+    ct = spin(ct)
+    ct = refresh_ts(ct)
+    return weave_fn(ct)
+
+
+def weft(weave_fn: WeaveFn, new_causal_tree_fn: Callable[[], CausalTree],
+         ct: CausalTree, ids_to_cut_yarns) -> CausalTree:
+    """Time travel: cut each named site's yarn at an id and rebuild the
+    sub-tree at that previous point in time (shared.cljc:268-293).
+    Combinations of ids that do not preserve causality are invalid and
+    yield gibberish trees, exactly as in the reference."""
+    filtered = [i for i in ids_to_cut_yarns if tuple(i) != ROOT_ID]
+    new_ct = new_causal_tree_fn()
+    yarns = dict(new_ct.yarns)
+    for nid in filtered:
+        nid = tuple(nid)
+        src_yarn = ct.yarns.get(nid[1], [])
+        cut = []
+        for n in src_yarn:
+            if n[0] == nid:
+                break
+            cut.append(n)
+        cut.append(node_from_kv((nid, ct.nodes[nid])))
+        yarns[nid[1]] = cut
+    new_ct = new_ct.evolve(
+        yarns=yarns,
+        site_id=ct.site_id,
+        lamport_ts=max((i[0] for i in filtered), default=0),
+        weaver=ct.weaver,
+    )
+    new_ct = yarns_to_nodes(new_ct)
+    return weave_fn(new_ct)
+
+
+def merge_trees(weave_fn: WeaveFn, ct1: CausalTree, ct2: CausalTree) -> CausalTree:
+    """Merge two causal trees into one (shared.cljc:300-314).
+
+    Same guards as the reference (type and uuid must match). Unlike the
+    reference's arbitrary-order reduce-insert (which is O(n*m) and can
+    trip the cause-must-exist check on unlucky iteration orders), we
+    insert ct2's novel nodes in sorted id order — causes always sort
+    before their effects, so the reduce is deterministic; the resulting
+    tree is identical because a weave is a pure function of the node set.
+    With ``weaver="jax"`` the merge is instead union + one batched
+    device reweave (see cause_tpu.weaver.jaxw), the north-star path.
+    """
+    if ct1.type != ct2.type:
+        raise CausalError(
+            "Causal type missmatch. Merge not allowed.",
+            {"causes": {"type-missmatch"}, "types": [ct1.type, ct2.type]},
+        )
+    if ct1.uuid != ct2.uuid:
+        raise CausalError(
+            "Causal UUID missmatch. Merge not allowed.",
+            {"causes": {"uuid-missmatch"}, "uuids": [ct1.uuid, ct2.uuid]},
+        )
+    for nid in sorted(ct2.nodes):
+        ct1 = insert(weave_fn, ct1, node_from_kv((nid, ct2.nodes[nid])))
+    return ct1
+
+
+def causal_to_edn(value, opts: Optional[dict] = None):
+    """Materialize a causal value to plain data; non-causal values pass
+    through (shared.cljc:320-328). Polymorphic over anything exposing a
+    ``causal_to_edn(opts)`` method (the CausalTo protocol,
+    protocols.cljc:33-35) — collections, bases, and refs."""
+    opts = opts or {}
+    m = getattr(value, "causal_to_edn", None)
+    if m is not None:
+        return m(opts)
+    return value

@@ -1,0 +1,77 @@
+"""Ordering and sorted-vector algorithms (reference: src/causal/util.cljc).
+
+These operate on plain Python lists kept in sorted order; comparison is
+native tuple comparison, which coincides with the reference's ``compare``
+for the id / node / reverse-path shapes used throughout.
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "lt",
+    "sorted_insertion_index",
+    "insert_sorted",
+    "binary_search",
+]
+
+
+def lt(a, b) -> bool:
+    """``<<`` — strictly-increasing comparison (util.cljc:4-10)."""
+    return a < b
+
+
+def sorted_insertion_index(coll, target, uniq: bool = False):
+    """Binary-search insertion index in an already-sorted list
+    (util.cljc:25-39). With ``uniq=True`` returns None when an exactly
+    equal element is already present (dedupe-on-insert)."""
+    low, high = 0, len(coll) - 1
+    while low <= high:
+        mid = (low + high) // 2
+        mid_val = coll[mid]
+        if mid_val == target:
+            return None if uniq else mid
+        if mid_val < target:
+            low = mid + 1
+        else:
+            high = mid - 1
+    return low
+
+
+def insert_sorted(coll, val, next_vals=None, index=None):
+    """Splice ``val`` (and optionally a run of ``next_vals``) into a list.
+
+    With ``index=None`` the list is assumed sorted and the sort is
+    maintained; if an equal element already exists the list is returned
+    unchanged (reference: util.cljc:41-48, the ``:uniq`` path).
+    Always returns a new list.
+    """
+    if index is None:
+        index = sorted_insertion_index(coll, val, uniq=True)
+        if index is None:
+            return list(coll)
+    out = list(coll[:index])
+    out.append(val)
+    if next_vals:
+        out.extend(next_vals)
+    out.extend(coll[index:])
+    return out
+
+
+def binary_search(xs, x, match_fn=None, less_than_fn=None):
+    """Binary search a sorted list with custom match / less-than predicates
+    (util.cljc:50-64). Returns a matching index or None."""
+    if match_fn is None:
+        match_fn = lambda v, t: v == t
+    if less_than_fn is None:
+        less_than_fn = lambda v, t: v < t
+    left, right = 0, len(xs) - 1
+    while left <= right:
+        i = (left + right) // 2
+        v = xs[i]
+        if match_fn(v, x):
+            return i
+        if less_than_fn(v, x):
+            left = i + 1
+        else:
+            right = i - 1
+    return None

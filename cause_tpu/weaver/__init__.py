@@ -1,0 +1,17 @@
+"""Weave backends.
+
+- :mod:`cause_tpu.weaver.pure` — the host-side sequential scan, the
+  semantics-defining default (reference: shared.cljc:194-241).
+- :mod:`cause_tpu.weaver.jaxw` — the TPU device weaver: batched
+  radix-sorted linearization + data-parallel visibility, vmap'd and
+  shardable across replicas (the framework's north star). Imported
+  lazily so host-only use never pays the JAX import.
+- :mod:`cause_tpu.weaver.arrays` — host<->device marshalling (site-id
+  interning, structure-of-arrays node buffers, id packing).
+
+Selected per-tree via the ``weaver`` field ("pure" | "jax").
+"""
+
+from . import pure  # noqa: F401
+
+BACKENDS = ("pure", "jax")

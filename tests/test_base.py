@@ -1,0 +1,291 @@
+"""CausalBase tests — port of reference test/causal/base/core_test.cljc."""
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import cbase as b
+from cause_tpu.ids import K, ROOT_ID
+
+
+def test_cb_to_edn():
+    """(core_test.cljc:8-14) — keywords stay whole, strings explode to
+    chars, nested collections flatten behind refs."""
+    cb = b.transact_(
+        b.new_cb(),
+        [[None, None, [K("div"), {K("foo"): "bar"}, "wat", [K("p"), "baz"]]]],
+    )
+    assert b.cb_to_edn(cb) == [
+        K("div"), {K("foo"): "bar"}, "w", "a", "t", [K("p"), "b", "a", "z"]
+    ]
+
+
+def test_map_to_nodes():
+    """(core_test.cljc:16-21)"""
+    cb = b.new_cb()
+    _, tx_index, nodes = b.map_to_nodes(cb, 0, {K("a"): 1, K("b"): 2})
+    assert tx_index == 2
+    assert nodes == [
+        ((1, cb.site_id, 0), K("a"), 1),
+        ((1, cb.site_id, 1), K("b"), 2),
+    ]
+
+
+def test_list_to_nodes():
+    """(core_test.cljc:22-28)"""
+    cb0 = b.new_cb()
+    cb, tx_index, nodes, last_node_id = b.list_to_nodes(cb0, 0, [1, 2, 3])
+    assert tx_index == 3
+    assert nodes == [
+        ((1, cb.site_id, 0), (0, "0", 0), 1),
+        ((1, cb.site_id, 1), (1, cb.site_id, 0), 2),
+        ((1, cb.site_id, 2), (1, cb.site_id, 1), 3),
+    ]
+    assert last_node_id == (1, cb.site_id, 2)
+
+
+def test_flatten_value():
+    """(core_test.cljc:32-56)"""
+    # map
+    cb, tx_i, c_ref = b.flatten_value(b.new_cb(), 0, {K("a"): {K("aa"): 1, K("bb"): 2, K("cc"): 3}})
+    assert tx_i == 4
+    assert b.is_ref(c_ref)
+    assert len(cb.collections) == 2
+    cb, tx_i, c_ref = b.flatten_value(b.new_cb(), 0, {K("a"): {K("b"): {K("c"): K("d")}}})
+    assert tx_i == 3
+    assert b.is_ref(c_ref)
+    assert len(cb.collections) == 3
+    # list
+    cb, tx_i, c_ref = b.flatten_value(b.new_cb(), 0, [1, [2, [3]]])
+    assert tx_i == 5
+    assert b.is_ref(c_ref)
+    assert len(cb.collections) == 3
+    cb, tx_i, c_ref = b.flatten_value(b.new_cb(), 0, [1, "hello", "world"])
+    assert tx_i == 11
+    assert b.is_ref(c_ref)
+    assert len(cb.collections) == 1
+    # combo
+    cb, tx_i, c_ref = b.flatten_value(
+        b.new_cb(), 0, [K("div"), {K("title"): "don't break"}, [K("span"), "break"]]
+    )
+    assert tx_i == 10
+    assert b.is_ref(c_ref)
+    assert len(cb.collections) == 3
+
+
+def test_transact():
+    """(core_test.cljc:58-82)"""
+    # new causal base
+    assert b.cb_to_edn(b.new_cb()) is None
+    # map transactions
+    cb = b.transact_(b.new_cb(), [[None, None, {K("a"): 1}]])
+    assert b.cb_to_edn(cb) == {K("a"): 1}
+    assert b.cb_to_edn(b.transact_(cb, [[cb.root_uuid, K("a"), "hi"]])) == {K("a"): "hi"}
+    assert b.cb_to_edn(
+        b.transact_(cb, [[cb.root_uuid, None, {K("a"): 2, K("b"): 3}]])
+    ) == {K("a"): 2, K("b"): 3}
+    assert b.cb_to_edn(
+        b.transact_(cb, [[cb.root_uuid, K("b"), {K("c"): 2}]])
+    ) == {K("a"): 1, K("b"): {K("c"): 2}}
+    assert b.cb_to_edn(
+        b.transact_(
+            cb,
+            [
+                [cb.root_uuid, K("a"), c.hide],
+                [cb.root_uuid, None, {K("b"): 2, K("c"): "hi"}],
+                [cb.root_uuid, None, {K("b"): c.hide}],
+            ],
+        )
+    ) == {K("c"): "hi"}
+    # list transactions
+    cb = b.transact_(b.new_cb(), [[None, None, [1, 2]]])
+    assert b.cb_to_edn(cb) == [1, 2]
+    assert b.cb_to_edn(b.transact_(cb, [[cb.root_uuid, c.root_id, 0]])) == [0, 1, 2]
+    assert b.cb_to_edn(b.transact_(cb, [[cb.root_uuid, c.root_id, [0]]])) == [0, 1, 2]
+    assert b.cb_to_edn(
+        b.transact_(cb, [[cb.root_uuid, c.root_id, [-2, -1, 0]]])
+    ) == [-2, -1, 0, 1, 2]
+    assert b.cb_to_edn(b.transact_(cb, [[cb.root_uuid, c.root_id, "hi"]])) == ["h", "i", 1, 2]
+    assert b.cb_to_edn(b.transact_(cb, [[cb.root_uuid, c.root_id, ["hi"]]])) == ["h", "i", 1, 2]
+    assert b.cb_to_edn(
+        b.transact_(cb, [[cb.root_uuid, c.root_id, [["hi"]]]])
+    ) == [["h", "i"], 1, 2]
+
+
+def test_site_id_shared_across_nested_collections():
+    """(core_test.cljc:79-82)"""
+    cb = b.transact_(
+        b.new_cb(),
+        [[None, None, [K("div"), {K("a"): 1}, [K("span"), {K("b"): 2}, "abc"]]]],
+    )
+    assert cb.history
+    for (nid, _uuid) in cb.history:
+        assert nid[1] == cb.site_id
+
+
+def test_causal_base_api():
+    """(core_test.cljc:87-92)"""
+    assert len(c.get_collection(c.base()) or []) == 0
+    assert c.get_collection(c.base()) is None
+    cb = c.transact(c.base(), [[None, None, [1, 2, 3]]])
+    assert len(c.get_collection(cb)) == 3
+    assert [n[2] for n in c.get_collection(cb)] == [1, 2, 3]
+
+
+def test_expand_reverse_path():
+    """(core_test.cljc:94-100)"""
+    cb = b.transact_(b.new_cb(), [[None, None, [1, 2, 3]]])
+    node, collection = b.expand_reverse_path(cb, cb.history[0])
+    assert node[2] == 1
+    assert collection.get_uuid()
+
+
+def test_reverse_path_to_path():
+    """(core_test.cljc:102-106)"""
+    cb = b.transact_(b.new_cb(), [[None, None, [1, 2, 3]]])
+    path = b.reverse_path_to_path(cb, cb.history[0])
+    assert path.uuid and path.node
+
+
+def test_tx_id_indexes():
+    """(core_test.cljc:108-119)"""
+    cb = b.new_cb()
+    cb = b.transact_(cb, [[None, None, {K("a"): 1, K("b"): 2}]])
+    cb = b.transact_(
+        cb,
+        [
+            [cb.root_uuid, K("a"), 3],
+            [cb.root_uuid, K("c"), 4],
+            [cb.root_uuid, K("e"), 5],
+        ],
+    )
+    last_tx_id = cb.history[-1][0][:2]
+    assert b.tx_id_indexes(cb, last_tx_id) == (2, 4)
+    for rp in cb.history[2:5]:
+        assert rp[0][0] == 2
+    assert b.tx_id_indexes(cb, (1, "bad site-id")) == (None, None)
+
+
+def test_subhis():
+    """(core_test.cljc:121-136)"""
+    cb = b.new_cb()
+    cb = b.transact_(cb, [[None, None, {K("a"): 1, K("b"): 2}]])
+    cb = b.transact_(
+        cb,
+        [
+            [cb.root_uuid, K("a"), 3],
+            [cb.root_uuid, K("c"), 4],
+            [cb.root_uuid, K("e"), 5],
+            [cb.root_uuid, K("f"), 6],
+        ],
+    )
+    last_tx_id = cb.history[-1][0][:2]
+    assert len(b.subhis(cb, last_tx_id)) == 4
+    assert len(b.subhis(cb, last_tx_id, None)) == 4
+    first_tx_id = cb.history[0][0][:2]
+    assert len(b.subhis(cb, None, first_tx_id)) == 2
+    assert len(b.subhis(cb, first_tx_id, last_tx_id)) == 6
+    assert len(b.subhis(cb, None, None)) == 6
+    assert len(b.subhis(cb, None, (0, cb.site_id))) == 0
+    assert len(b.subhis(cb, (5, cb.site_id), None)) == 0
+
+
+def test_invert_path():
+    """(core_test.cljc:138-143)"""
+    assert b.invert_path(
+        b.Path(uuid="yVqwAa8ypPGRC_p3wdKhS",
+               node=((1, "QeVBlHoQFZSx0", 0), K("a"), 1))
+    ) == ("yVqwAa8ypPGRC_p3wdKhS", (1, "QeVBlHoQFZSx0", 0), c.h_hide)
+
+
+def test_invert():
+    """(core_test.cljc:145-155)"""
+    cb = b.new_cb()
+    cb = b.transact_(cb, [[None, None, {K("a"): 1, K("b"): 2}]])
+    cb = b.transact_(cb, [[cb.root_uuid, K("a"), 3]])
+    cb = b.transact_(cb, [[cb.root_uuid, K("c"), [1, 2, 3]]])
+    cb = b.transact_(cb, [[cb.root_uuid, K("c"), c.hide]])
+    assert b.get_collection_(cb)[K("a")] == 3
+    assert len(cb.history) == 8
+    cb = b.invert_(cb, cb.history)
+    assert b.get_collection_(cb)[K("a")] is None
+    assert len(cb.history) == 13
+
+
+def test_get_next_tx_id():
+    """(core_test.cljc:157-167)"""
+    cb = b.new_cb()
+    cb = b.transact_(cb, [[None, None, {K("a"): 1, K("b"): 2}]])
+    cb = b.transact_(cb, [[cb.root_uuid, K("a"), 3]])
+    assert b.get_next_tx_id(cb, cb.last_undo_lamport_ts)[0] == 2
+    cb = cb.evolve(last_undo_lamport_ts=2)
+    assert b.get_next_tx_id(cb, cb.last_undo_lamport_ts)[0] == 1
+    cb = cb.evolve(last_undo_lamport_ts=1)
+    assert b.get_next_tx_id(cb, cb.last_undo_lamport_ts) is None
+    cb = cb.evolve(last_undo_lamport_ts=None)
+    assert b.get_next_tx_id(cb, cb.last_undo_lamport_ts)[0] == 2
+
+
+def test_undo_and_redo():
+    """(core_test.cljc:169-209) — the undo/redo state machine."""
+    # undo in a map
+    cb = b.new_cb()
+    cb = b.transact_(cb, [[None, None, {K("a"): 1, K("b"): 2}]])
+    cb = b.transact_(cb, [[cb.root_uuid, K("a"), 3]])
+    root = lambda: b.get_collection_(cb)
+    assert root()[K("a")] == 3
+    assert root()[K("b")] == 2
+    cb = b.undo_(cb)
+    assert root()[K("a")] == 1
+    assert root()[K("b")] == 2
+    cb = b.undo_(cb)
+    assert root()[K("a")] is None
+    assert root()[K("b")] is None
+    # redo in a map
+    cb = b.redo_(cb)
+    assert root()[K("a")] == 1
+    assert root()[K("b")] == 2
+    cb = b.redo_(cb)
+    assert root()[K("a")] == 3
+    assert root()[K("b")] == 2
+    # undo in a list
+    cb = b.new_cb()
+    cb = b.transact_(cb, [[None, None, [1]]])
+    cb = b.transact_(cb, [[cb.root_uuid, c.root_id, [2]]])
+    cb = b.transact_(cb, [[cb.root_uuid, c.root_id, [3]]])
+    head = lambda: (lambda nodes: nodes[0][2] if nodes else None)(
+        list(b.get_collection_(cb))
+    )
+    assert head() == 3
+    cb = b.undo_(cb)
+    assert head() == 2
+    cb = b.undo_(cb)
+    assert head() == 1
+    cb = b.undo_(cb)
+    assert head() is None
+    # redo in a list
+    cb = b.redo_(cb)
+    assert head() == 1
+    cb = b.redo_(cb)
+    assert head() == 2
+    cb = b.redo_(cb)
+    assert head() == 3
+    cb = b.redo_(cb)  # never redo past the last transact
+    assert head() == 3
+
+
+def test_set_site_id():
+    """(core_test.cljc:211-220)"""
+    cb = c.base().set_site_id("my-site-id").transact([[None, None, [1]]])
+    nodes = list(c.get_collection(cb))
+    assert nodes[0][0][1] == "my-site-id"
+
+
+def test_validate_tx_part_errors():
+    """(base/core.cljc:210-220)"""
+    with pytest.raises(c.CausalError):
+        b.transact_(b.new_cb(), [["nonexistent-uuid", None, {K("a"): 1}]])
+    with pytest.raises(c.CausalError):
+        b.transact_(b.new_cb(), [[None, None, 42]])  # root must be a coll
+    cb = b.transact_(b.new_cb(), [[None, None, [1]]])
+    with pytest.raises(c.CausalError):
+        b.transact_(cb, [["missing", None, 1]])

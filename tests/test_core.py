@@ -1,0 +1,40 @@
+"""API facade smoke tests — port of reference test/causal/core_test.cljc."""
+
+import cause_tpu as c
+from cause_tpu.ids import K
+
+
+def test_core_api():
+    """(core_test.cljc:5-15)"""
+    assert c.causal_to_edn(
+        c.transact(
+            c.base(),
+            [[None, None, [K("tag"), {K("a"): 1, K("b"): "together"}, "split"]]],
+        )
+    ) == [K("tag"), {K("a"): 1, K("b"): "together"}, "s", "p", "l", "i", "t"]
+
+    cb = c.base()
+    cb = c.transact(cb, [[None, None, [2, 3]]])
+    cb = c.transact(
+        cb, [[c.get_uuid(c.get_collection(cb)), c.root_id, 1]]
+    )
+    assert c.causal_to_edn(cb) == [1, 2, 3]
+
+
+def test_specials_do_not_compose():
+    """core.cljc:13-14: hide of a hide is not a show."""
+    assert c.hide is c.HIDE
+    assert c.hide is not c.h_show
+
+
+def test_node_constructor():
+    """shared.cljc:77-98"""
+    assert c.node(1, "site", (0, "0", 0), "v") == ((1, "site", 0), (0, "0", 0), "v")
+    assert c.node(1, "site", 2, (0, "0", 0), "v") == ((1, "site", 2), (0, "0", 0), "v")
+
+
+def test_meta_accessors():
+    cl = c.clist("x")
+    assert isinstance(c.get_uuid(cl), str) and len(c.get_uuid(cl)) == 21
+    assert isinstance(c.get_site_id(cl), str) and len(c.get_site_id(cl)) == 13
+    assert c.get_ts(cl) == 1

@@ -1,0 +1,315 @@
+"""CausalList tests — port of reference test/causal/collections/list_test.cljc.
+
+Carries over the reference's three-legged correctness strategy:
+1. the regression corpus of hand-minimized weave edge cases (:44-96),
+2. the idempotency oracle — incremental weave must equal a from-scratch
+   rebuild of every cache from the bag of nodes (:34-41),
+3. randomized multi-site fuzzing of that same property (:98-116), plus
+   the "concurrent runs stick together" convergence property (:132-160).
+"""
+
+import random
+import string
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections import shared as s
+from cause_tpu.ids import ROOT_ID, new_site_id
+
+
+SIMPLE_VALUES = (
+    [c.hide, c.hide, c.h_hide, c.h_hide, c.h_show, c.h_show,
+     " ", " ", " ", " ", "\n"]
+    + [chr(ch) for ch in range(97, 97 + 26)]
+)
+
+
+def rand_node(rng, causal_list, site_id=None, value=None):
+    """Mint a random foreign node like the reference fuzzer
+    (list_test.cljc:15-29): random existing cause, ts one past the max of
+    the cause's ts and the site's yarn tip."""
+    ct = causal_list.ct
+    if value is None:
+        value = rng.choice(SIMPLE_VALUES)
+    cause = rng.choice(list(ct.nodes.keys()))
+    yarn = ct.yarns.get(site_id)
+    yarn_ts = yarn[-1][0][0] if yarn else 0
+    lamport_ts = 1 + max(cause[0], yarn_ts)
+    return c.node(lamport_ts, site_id, cause, value)
+
+
+def assert_idempotent(causal_list):
+    """The idempotency oracle (list_test.cljc:34-41): rebuilding all
+    caches from ``nodes`` must reproduce the incrementally-maintained
+    tree exactly."""
+    ct = causal_list.ct
+    refreshed = s.refresh_caches(c_list.weave, ct)
+    assert ct.site_id == refreshed.site_id
+    assert ct.lamport_ts == refreshed.lamport_ts
+    assert ct.nodes == refreshed.nodes
+    assert ct.yarns == refreshed.yarns
+    assert ct.weave == refreshed.weave
+
+
+# Hand-minimized node sets mined from past fuzz failures
+# (list_test.cljc:44-96), values as 1-char strings.
+EDGE_CASES = [
+    [((1, "xT_odlTBwTRNU", 0), (0, "0", 0), c.hide),
+     ((2, "9FyYzf9pum6E4", 0), (1, "xT_odlTBwTRNU", 0), "d"),
+     ((3, "9FyYzf9pum6E4", 0), (0, "0", 0), "r"),
+     ((4, "NwudSBdQg3Ru2", 0), (3, "9FyYzf9pum6E4", 0), " "),
+     ((4, "9FyYzf9pum6E4", 0), (0, "0", 0), "d")],
+    [((1, "xT_odlTBwTRNU", 0), (0, "0", 0), " "),
+     ((2, "xT_odlTBwTRNU", 0), (0, "0", 0), "b"),
+     ((2, "NwudSBdQg3Ru2", 0), (1, "xT_odlTBwTRNU", 0), "q"),
+     ((2, "9FyYzf9pum6E4", 0), (1, "xT_odlTBwTRNU", 0), " ")],
+    [((1, "Pz8iuNCXvVsYN", 0), (0, "0", 0), "o"),
+     ((2, "Pz8iuNCXvVsYN", 0), (1, "Pz8iuNCXvVsYN", 0), c.hide),
+     ((3, "9FyYzf9pum6E4", 0), (2, "Pz8iuNCXvVsYN", 0), "u"),
+     ((2, "NwudSBdQg3Ru2", 0), (1, "Pz8iuNCXvVsYN", 0), " ")],
+    [((1, "W7XhooU1Hsw7E", 0), (0, "0", 0), "j"),
+     ((1, "VdIJLRISw~zgo", 0), (0, "0", 0), "w"),
+     ((1, "A~iIXinAXkGX7", 0), (0, "0", 0), c.hide)],
+    [((1, "W7XhooU1Hsw7E", 0), (0, "0", 0), "u"),
+     ((2, "W7XhooU1Hsw7E", 0), (1, "W7XhooU1Hsw7E", 0), " "),
+     ((2, "7hLbMKLvcll_4", 0), (1, "W7XhooU1Hsw7E", 0), c.hide),
+     ((1, "VdIJLRISw~zgo", 0), (0, "0", 0), "m")],
+    [((1, "Ftbpo0oG7ZnpR", 0), (0, "0", 0), c.hide),
+     ((1, "A~iIXinAXkGX7", 0), (0, "0", 0), c.hide)],
+    [((1, "VdIJLRISw~zgo", 0), (0, "0", 0), c.hide),
+     ((2, "A~iIXinAXkGX7", 0), (1, "VdIJLRISw~zgo", 0), "j"),
+     ((3, "A~iIXinAXkGX7", 0), (0, "0", 0), "i"),
+     ((1, "W7XhooU1Hsw7E", 0), (0, "0", 0), "s")],
+    [((1, " f ", 0), (0, "0", 0), c.hide),
+     ((2, " z ", 0), (1, " f ", 0), " "),
+     ((2, " f ", 0), (0, "0", 0), "l"),
+     ((2, " a ", 0), (1, " f ", 0), "v")],
+    [((1, " f ", 0), (0, "0", 0), c.hide),
+     ((2, " f ", 0), (0, "0", 0), c.hide),
+     ((3, " a ", 0), (2, " f ", 0), "c"),
+     ((2, " z ", 0), (1, " f ", 0), "r")],
+]
+
+
+@pytest.mark.parametrize("nodes", EDGE_CASES, ids=range(len(EDGE_CASES)))
+def test_known_idempotent_insert_edge_cases(nodes):
+    cl = c.clist()
+    for n in nodes:
+        cl = cl.insert(n)
+    assert_idempotent(cl)
+
+
+def find_weave_inconsistencies(rng, max_steps=9):
+    """(list_test.cljc:98-112) — compare the incremental weave against a
+    full reweave after every random insert; return a repro on mismatch."""
+    site_ids = [new_site_id() for _ in range(5)]
+    cl = c.clist()
+    insertions = list(cl.get_weave())
+    for step in range(max_steps):
+        full = c_list.weave(cl.ct)
+        if cl.get_weave() != full.weave:
+            return {
+                "insertions": insertions,
+                "step": step,
+                "initial": cl.causal_to_edn(),
+                "reweave": c_list.causal_list_to_edn(full),
+            }
+        n = rand_node(rng, cl, site_id=rng.choice(site_ids))
+        cl = cl.insert(n)
+        insertions.append(n)
+    return None
+
+
+def test_try_to_find_new_idempotent_edge_cases():
+    rng = random.Random(0xC0FFEE)
+    failures = [
+        f for f in (find_weave_inconsistencies(rng) for _ in range(99)) if f
+    ]
+    assert failures == []
+
+
+def test_fuzz_full_idempotency_oracle():
+    """Stronger than the reference: run the full cache oracle (not just
+    the weave) across random multi-site insert sequences."""
+    rng = random.Random(1234)
+    for _ in range(25):
+        site_ids = [new_site_id() for _ in range(5)]
+        cl = c.clist()
+        for _ in range(12):
+            cl = cl.insert(rand_node(rng, cl, site_id=rng.choice(site_ids)))
+        assert_idempotent(cl)
+
+
+PROSE = (
+    "Hereupon Legrand arose, with a grave and stately air, and brought me "
+    "the beetle from a glass case in which it was enclosed. It was a "
+    "beautiful scarabaeus, and, at that time, unknown to naturalists of "
+    "course a great prize in a scientific point of view. There were two "
+    "round black spots near one extremity of the back, and a long one near "
+    "the other. The scales were exceedingly hard and glossy, with all the "
+    "appearance of burnished gold."
+).split(" ")
+
+
+def rand_phrase(rng):
+    t = 2 + rng.randrange(6)
+    d = max(0, rng.randrange(len(PROSE)) - t)
+    return " ".join(PROSE[d:d + t])
+
+
+def rand_weave_of_phrases(rng, n_phrases=3):
+    """(list_test.cljc:132-155) — each phrase is typed char-by-char by its
+    own site; sites interleave round-robin into one list."""
+    starting_phrases = [f" <{rand_phrase(rng)}> " for _ in range(n_phrases)]
+    cl = c.clist()
+    phrase = list(starting_phrases[0])
+    phrases = starting_phrases[1:]
+    site_id = new_site_id()
+    while phrase:
+        yarn = cl.ct.yarns.get(site_id)
+        cause = yarn[-1] if yarn else None
+        n = c.node(
+            1 + (cause[0][0] if cause else 1),
+            site_id,
+            cause[0] if cause else ROOT_ID,
+            phrase[0],
+        )
+        cl = cl.insert(n)
+        phrase = phrase[1:]
+        if not phrase and phrases:
+            phrase = list(phrases[0])
+            phrases = phrases[1:]
+            site_id = new_site_id()
+    return {
+        "cl": cl,
+        "phrases": starting_phrases,
+        "materialized_weave": "".join(cl.causal_to_edn()),
+        "materialized_reweave": "".join(
+            c_list.causal_list_to_edn(c_list.weave(cl.ct))
+        ),
+    }
+
+
+def test_concurrent_runs_stick_together():
+    rng = random.Random(42)
+    result = rand_weave_of_phrases(rng, 5)
+    for phrase in result["phrases"]:
+        assert phrase in result["materialized_weave"]
+    assert result["materialized_weave"] == result["materialized_reweave"]
+
+
+def test_hide_and_show_and_hide_and_show():
+    """(list_test.cljc:162-173)"""
+    cl = c.clist("a", "b", "c")
+    a_node = cl.get_weave()[1]
+    assert cl.causal_to_edn() == ["a", "b", "c"]
+    cl = cl.append(a_node[0], c.hide)
+    assert cl.causal_to_edn() == ["b", "c"]
+    cl = cl.append(a_node[0], c.h_show)
+    assert cl.causal_to_edn() == ["a", "b", "c"]
+    cl = cl.append(a_node[0], c.hide)
+    assert cl.causal_to_edn() == ["b", "c"]
+    cl = cl.append(a_node[0], c.h_show)
+    assert cl.causal_to_edn() == ["a", "b", "c"]
+
+
+def test_core_list_protocol():
+    """(list_test.cljc:175-202) — len counts active values; iteration
+    yields visible nodes."""
+    assert len(c.clist()) == 0
+    assert list(c.clist("foo", "bar"))
+    assert len(c.clist("foo").conj(c.hide)) == 0
+    ct = c.clist("foo")
+    n = list(ct)[0]
+    shown = ct.append(n[0], c.hide).append(n[0], c.h_show)
+    assert list(shown)
+    assert len(shown) == 1
+    assert len(c.clist()) == 0
+    assert len(c.clist("foo")) == 1
+
+    node = ((1, "site-id", 0), ROOT_ID, "foo")
+    inserted = c.clist().insert(node)
+    assert list(inserted) == [node]
+    assert list(inserted)[0] == node
+    assert list(inserted)[-1] == node
+    two = inserted.append(ROOT_ID, "bar")
+    assert list(two)[1:] == [node]
+    assert isinstance(hash(c.clist("foo")), int)
+
+
+def test_insert_validations():
+    """shared.cljc:163-181 error cases."""
+    cl = c.clist()
+    node = ((1, "siteA_________", 0), ROOT_ID, "x")
+    cl = cl.insert(node)
+    # idempotent re-insert is a no-op
+    assert cl.insert(node) == cl
+    # same id, different body: append-only violation
+    with pytest.raises(c.CausalError):
+        cl.insert(((1, "siteA_________", 0), ROOT_ID, "y"))
+    # cause must exist
+    with pytest.raises(c.CausalError):
+        cl.insert(((2, "siteA_________", 0), (9, "nope", 0), "z"))
+    # nodes must share one tx
+    with pytest.raises(c.CausalError):
+        cl.insert(
+            ((3, "siteA_________", 0), node[0], "a"),
+            [((4, "siteB_________", 0), node[0], "b")],
+        )
+    # lamport fast-forward
+    cl2 = cl.insert(((9, "siteB_________", 0), node[0], "w"))
+    assert cl2.get_ts() == 9
+
+
+def test_weft_time_travel():
+    """shared.cljc:268-293: cutting yarns reconstructs a prior state."""
+    cl = c.clist("a", "b", "c")
+    ids = [n[0] for n in cl.get_weave()[1:]]  # a, b, c in weave order
+    earlier = cl.weft([ids[0]])  # cut after "a"
+    assert earlier.causal_to_edn() == ["a"]
+    assert earlier.get_site_id() == cl.get_site_id()
+
+
+def test_merge_convergence_and_idempotence():
+    """shared.cljc:300-314: merge is commutative and idempotent on the
+    rendered value and on the node set."""
+    from cause_tpu.collections.clist import CausalList
+
+    cl = c.clist("h", "i")
+    # each replica edits under its own site-id (same-site divergence is
+    # invalid CRDT usage and trips the append-only guard, as it should)
+    a = CausalList(cl.ct.evolve(site_id=new_site_id())).conj("!")
+    b = CausalList(cl.ct.evolve(site_id=new_site_id())).cons(">")
+    ab = a.merge(b)
+    ba = b.merge(a)
+    assert ab.causal_to_edn() == ba.causal_to_edn()
+    assert ab.get_nodes() == ba.get_nodes()
+    assert ab.merge(b).get_nodes() == ab.get_nodes()
+    # type/uuid guards
+    with pytest.raises(c.CausalError):
+        a.merge(c.clist("x"))
+
+
+def test_merge_rand_multi_site():
+    """Randomized convergence: divergent replicas merge to one state in
+    any merge order."""
+    rng = random.Random(7)
+    base = c.clist("s", "e", "e", "d")
+    replicas = []
+    for _ in range(4):
+        r = base
+        site = new_site_id()
+        for _ in range(6):
+            r = r.insert(rand_node(rng, r, site_id=site))
+        replicas.append(r)
+    merged_fwd = replicas[0]
+    for r in replicas[1:]:
+        merged_fwd = merged_fwd.merge(r)
+    merged_rev = replicas[-1]
+    for r in reversed(replicas[:-1]):
+        merged_rev = merged_rev.merge(r)
+    assert merged_fwd.get_nodes() == merged_rev.get_nodes()
+    assert merged_fwd.causal_to_edn() == merged_rev.causal_to_edn()
+    assert_idempotent(merged_fwd)

@@ -1,0 +1,120 @@
+"""CausalMap tests — port of reference test/causal/collections/map_test.cljc."""
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu.ids import ROOT_ID
+
+
+def test_basic_map():
+    """(map_test.cljc:5-15)"""
+    cm = (
+        c.cmap()
+        .assoc("foo", "bar")
+        .assoc("fizz", "buzz")
+        .assoc("fizz", "bang")
+        .dissoc("foo")
+        .assoc("list", c.clist("a", "b", "c"))
+    )
+    assert cm.causal_to_edn() == {"fizz": "bang", "list": ["a", "b", "c"]}
+
+
+def test_hide_and_show_and_hide_and_show():
+    """(map_test.cljc:17-31)"""
+    cm = c.cmap("foo", "bar", "fizz", "buzz")
+    assert cm.causal_to_edn() == {"foo": "bar", "fizz": "buzz"}
+    cm = cm.append("foo", c.hide)
+    assert cm.causal_to_edn() == {"fizz": "buzz"}
+    cm = cm.append("foo", c.h_show)
+    assert cm.causal_to_edn() == {"foo": "bar", "fizz": "buzz"}
+    cm = cm.append("foo", c.hide)
+    assert cm.causal_to_edn() == {"fizz": "buzz"}
+    cm = cm.append("foo", c.h_show)
+    assert cm.causal_to_edn() == {"foo": "bar", "fizz": "buzz"}
+    cm = cm.append("foo", "boo")
+    cm = cm.append("foo", c.h_show)
+    cm = cm.append("foo", c.h_show)
+    assert cm.causal_to_edn() == {"foo": "boo", "fizz": "buzz"}
+
+
+def test_hide_and_show_by_node_id():
+    """(map_test.cljc:33-43) — id-caused undo of an LWW overwrite."""
+    cm = c.cmap("foo", "bar")
+    assert cm.causal_to_edn() == {"foo": "bar"}
+    cm = cm.append("foo", "boo")
+    assert cm.causal_to_edn() == {"foo": "boo"}
+    boo_id = list(cm)[0][0]
+    cm = cm.append(boo_id, c.hide)
+    assert cm.causal_to_edn() == {"foo": "bar"}
+    cm = cm.append(boo_id, c.h_show)
+    assert cm.causal_to_edn() == {"foo": "boo"}
+
+
+def test_core_map_protocol():
+    """(map_test.cljc:45-89)"""
+    assert len(c.cmap()) == 0
+    assert list(c.cmap("foo", "bar"))
+    assert len(c.cmap("foo", "bar").dissoc("foo")) == 0
+    assert list(c.cmap("foo", "bar").dissoc("foo").assoc("foo", c.h_show))
+    assert c.cmap("foo", "bar")["foo"] == "bar"
+    assert c.cmap("foo", "bar").get("foo") == "bar"
+    nested = c.cmap("foo", c.cmap("foo", "bar"))
+    assert nested["foo"]["foo"] == "bar"
+    assert len(c.cmap()) == 0
+    assert len(c.cmap("foo", "bar")) == 1
+    assert len(c.cmap("foo", "bar").dissoc("foo")) == 0
+    assert len(c.cmap("foo", "bar").dissoc("foo").assoc("foo", c.h_show)) == 1
+
+    node = ((1, "site-id", 0), "fizz", "buzz")
+    inserted = c.cmap().insert(node)
+    assert list(inserted)[0] == node
+    assert list(inserted)[-1] == node
+    assert list(inserted)[1:] == []
+    two = inserted.assoc("foo", "bar")
+    assert list(two)[1:] == [node]  # newest key first
+    # a re-inserted node shows through a hidden sibling key
+    assert list(c.cmap("foo", "bar").dissoc("foo").insert(node)) == [node]
+
+    assert c.cmap().conj({"foo": "bar"})["foo"] == "bar"
+    assert isinstance(hash(c.cmap("foo", "bar")), int)
+    assert str(c.cmap("foo", "bar")) == "{'foo': 'bar'}"
+    assert c.cmap("foo", "bar").dissoc("foo").get("foo") is None
+    assert (
+        c.cmap("foo", "bar").dissoc("foo").assoc("foo", c.h_show).get("foo")
+        == "bar"
+    )
+
+
+def test_assoc_skips_equal_value():
+    """map.cljc:75-81: setting a key to its current value writes no node."""
+    cm = c.cmap("k", 1)
+    assert cm.assoc("k", 1) == cm
+    assert cm.assoc("k", 2) != cm
+
+
+def test_dissoc_missing_key_is_noop():
+    """map.cljc:83-89: only existing keys get tombstoned."""
+    cm = c.cmap("k", 1)
+    assert cm.dissoc("nope") == cm
+
+
+def test_map_merge_lww():
+    """Concurrent writers converge; higher id wins the register."""
+    from cause_tpu.collections.cmap import CausalMap
+    from cause_tpu.ids import new_site_id
+
+    base = c.cmap("k", "v0")
+    a = CausalMap(base.ct.evolve(site_id=new_site_id())).append("k", "a-wins")
+    b = CausalMap(base.ct.evolve(site_id=new_site_id())).append("k", "b-wins")
+    ab = a.merge(b)
+    ba = b.merge(a)
+    assert ab.causal_to_edn() == ba.causal_to_edn()
+    # winner is the larger (ts, site, tx) id
+    a_node = list(a)[0]
+    b_node = list(b)[0]
+    winner = a_node if a_node[0] > b_node[0] else b_node
+    assert ab["k"] == winner[2]
+
+
+def test_map_kwargs_constructor():
+    assert c.cmap(foo="bar").causal_to_edn() == {"foo": "bar"}

@@ -1,0 +1,64 @@
+"""Util tests (reference: src/causal/util.cljc)."""
+
+from cause_tpu import util as u
+from cause_tpu.ids import K, Keyword, Special, HIDE, H_HIDE, H_SHOW, is_id, is_special, node
+
+
+def test_sorted_insertion_index():
+    assert u.sorted_insertion_index([], 5) == 0
+    assert u.sorted_insertion_index([1, 3, 5], 4) == 2
+    assert u.sorted_insertion_index([1, 3, 5], 0) == 0
+    assert u.sorted_insertion_index([1, 3, 5], 9) == 3
+    assert u.sorted_insertion_index([1, 3, 5], 3) == 1
+    assert u.sorted_insertion_index([1, 3, 5], 3, uniq=True) is None
+
+
+def test_insert_sorted():
+    assert u.insert_sorted([1, 3, 5], 4) == [1, 3, 4, 5]
+    assert u.insert_sorted([1, 3, 5], 3) == [1, 3, 5]  # uniq no-op
+    assert u.insert_sorted([1, 5], 2, next_vals=[3, 4]) == [1, 2, 3, 4, 5]
+    assert u.insert_sorted([], 1) == [1]
+
+
+def test_binary_search():
+    assert u.binary_search([1, 3, 5], 3) == 1
+    assert u.binary_search([1, 3, 5], 4) is None
+    assert u.binary_search([1, 3, 5], 5) == 2
+    # custom predicates, as used on history reverse-paths
+    history = [((1, "a", 0), "u"), ((1, "a", 1), "u"), ((2, "b", 0), "u")]
+    i = u.binary_search(
+        history, (2, "b", 0),
+        match_fn=lambda rp, t: rp[0] == t,
+        less_than_fn=lambda rp, t: rp[0] < t,
+    )
+    assert i == 2
+
+
+def test_id_ordering_is_lexicographic():
+    assert (1, "a", 0) < (1, "b", 0) < (2, "a", 0) < (2, "a", 1)
+
+
+def test_specials_interned():
+    assert Special("hide") is HIDE
+    assert is_special(HIDE) and is_special(H_HIDE) and is_special(H_SHOW)
+    assert not is_special(":causal/hide")
+    assert repr(HIDE) == ":causal/hide"
+
+
+def test_keywords_interned():
+    assert K("a") is Keyword("a")
+    assert repr(K("div")) == ":div"
+
+
+def test_is_id():
+    assert is_id((1, "site", 0))
+    assert not is_id("key")
+    assert not is_id((1, 2, 3))
+    assert not is_id((1, "site", 0, 9))
+
+
+def test_node_rejects_self_cause():
+    import pytest
+
+    with pytest.raises(ValueError):
+        node(1, "s", (1, "s", 0), "v")
