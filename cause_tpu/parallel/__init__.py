@@ -1,0 +1,9 @@
+"""Multi-chip sharding of the batched weave/merge kernels
+(mesh + shard_map + collectives)."""
+
+from .mesh import (  # noqa: F401
+    REPLICA_AXIS,
+    make_mesh,
+    replica_digest,
+    sharded_merge_weave,
+)

@@ -1,0 +1,201 @@
+"""Host <-> device marshalling for the JAX weaver.
+
+The device never sees values, site-id strings, or Python objects — only
+fixed-width integer lanes (the "ids and classes only" contract from the
+build plan, SURVEY.md §7):
+
+- ``ts``, ``site``, ``tx`` (int32): the node id triple with site-id
+  strings interned to **order-preserving** integer ranks, so
+  lexicographic (ts, site_rank, tx) order equals the host id order.
+  Ranks must be computed over the union of sites in play (all trees of
+  a merge/batch) or cross-replica comparisons would disagree.
+- ``cause_idx`` (int32): index of the cause node in the same array
+  (-1 for the root and for key-caused map nodes).
+- ``vclass`` (int32): 0 normal, 1 hide, 2 h.hide, 3 h.show
+  (the special values of shared.cljc:21).
+- ``valid`` (bool): padding mask — trees grow, TPU shapes don't.
+
+Node ids also pack into a two-lane **(hi, lo) int32 pair**
+(``PackSpec``: hi = ts, lo = site_rank<<tx_bits | tx) for duplicate
+elimination and sort-join cause resolution in the batched merge kernel.
+Two int32 lanes, not one int64: JAX under default (non-x64) config
+silently downcasts int64, and TPUs prefer 32-bit lanes anyway.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..ids import HIDE, H_HIDE, H_SHOW, ROOT_ID, is_special
+
+__all__ = [
+    "VCLASS_NORMAL",
+    "VCLASS_HIDE",
+    "VCLASS_H_HIDE",
+    "VCLASS_H_SHOW",
+    "PackSpec",
+    "DEFAULT_PACK",
+    "SiteInterner",
+    "NodeArrays",
+    "vclass_of",
+    "next_pow2",
+]
+
+VCLASS_NORMAL = 0
+VCLASS_HIDE = 1
+VCLASS_H_HIDE = 2
+VCLASS_H_SHOW = 3
+
+
+def vclass_of(value) -> int:
+    if value is HIDE:
+        return VCLASS_HIDE
+    if value is H_HIDE:
+        return VCLASS_H_HIDE
+    if value is H_SHOW:
+        return VCLASS_H_SHOW
+    return VCLASS_NORMAL
+
+
+def next_pow2(n: int) -> int:
+    p = 8
+    while p < n:
+        p <<= 1
+    return p
+
+
+@dataclass(frozen=True)
+class PackSpec:
+    """Bit layout for the (hi, lo) id lanes: ``hi = ts`` (int32) and
+    ``lo = (site_rank << tx_bits) | tx`` (int32). Defaults allow
+    ts < 2^31, 2^18 sites, tx < 2^13 (31 bits in lo); ``check`` raises
+    before any silent wraparound. Lexicographic (hi, lo) order equals
+    id order."""
+
+    site_bits: int = 18
+    tx_bits: int = 13
+
+    def check(self, max_ts: int, n_sites: int, max_tx: int) -> None:
+        if max_ts >= (1 << 31):
+            raise OverflowError(f"lamport-ts {max_ts} exceeds 31 bits")
+        if n_sites > (1 << self.site_bits):
+            raise OverflowError(f"{n_sites} sites exceed {self.site_bits} bits")
+        if max_tx >= (1 << self.tx_bits):
+            raise OverflowError(f"tx-index {max_tx} exceeds {self.tx_bits} bits")
+
+    def pack_lo(self, site, tx):
+        """Works on numpy arrays or jax arrays (pure int32 arithmetic)."""
+        return (site.astype(np.int32) << self.tx_bits) | tx.astype(np.int32)
+
+
+DEFAULT_PACK = PackSpec()
+
+I32_MAX = np.int32(np.iinfo(np.int32).max)
+
+
+class SiteInterner:
+    """Order-preserving site-id -> rank mapping over a fixed site set.
+
+    Built from the union of every site involved in a kernel invocation;
+    sorted-string order defines the ranks, so integer comparisons on
+    ranks agree with the host's lexicographic id order (SURVEY.md §7
+    hard part 3)."""
+
+    def __init__(self, sites):
+        self.sites: List[str] = sorted(set(sites))
+        self.rank: Dict[str, int] = {s: i for i, s in enumerate(self.sites)}
+
+    def __len__(self) -> int:
+        return len(self.sites)
+
+    def __getitem__(self, site: str) -> int:
+        return self.rank[site]
+
+
+@dataclass
+class NodeArrays:
+    """Structure-of-arrays view of one causal tree's nodes, padded to
+    ``capacity``. ``nodes[i]`` is the host node triple for lane i; the
+    root sentinel is always lane 0 (ids sort it first)."""
+
+    ts: np.ndarray
+    site: np.ndarray
+    tx: np.ndarray
+    cause_idx: np.ndarray
+    vclass: np.ndarray
+    valid: np.ndarray
+    nodes: list
+    interner: SiteInterner
+    n: int
+
+    @property
+    def capacity(self) -> int:
+        return int(self.ts.shape[0])
+
+    @classmethod
+    def from_nodes_map(
+        cls,
+        nodes_map: dict,
+        capacity: Optional[int] = None,
+        interner: Optional[SiteInterner] = None,
+    ) -> "NodeArrays":
+        """Build device lanes from a ``{id: (cause, value)}`` store.
+        Lanes are in sorted id order (so lane index order == id order
+        and every cause precedes its effects)."""
+        ids = sorted(nodes_map)
+        n = len(ids)
+        cap = capacity or next_pow2(n)
+        if cap < n:
+            raise ValueError(f"capacity {cap} < node count {n}")
+        if interner is None:
+            interner = SiteInterner(i[1] for i in ids)
+        idx_of = {nid: i for i, nid in enumerate(ids)}
+        ts = np.zeros(cap, np.int32)
+        site = np.zeros(cap, np.int32)
+        tx = np.zeros(cap, np.int32)
+        cause_idx = np.full(cap, -1, np.int32)
+        vclass = np.zeros(cap, np.int32)
+        valid = np.zeros(cap, bool)
+        nodes = []
+        for i, nid in enumerate(ids):
+            cause, value = nodes_map[nid]
+            ts[i], site[i], tx[i] = nid[0], interner[nid[1]], nid[2]
+            ci = idx_of.get(cause, -1) if isinstance(cause, tuple) else -1
+            cause_idx[i] = ci
+            vclass[i] = vclass_of(value)
+            valid[i] = True
+            nodes.append((nid, cause, value))
+        return cls(
+            ts=ts, site=site, tx=tx, cause_idx=cause_idx, vclass=vclass,
+            valid=valid, nodes=nodes, interner=interner, n=n,
+        )
+
+    def id_lanes(self, spec: PackSpec = DEFAULT_PACK):
+        """(hi, lo) int32 id lanes; padding lanes get int32 max so they
+        sort last (real ids never reach int32 max by ``check``)."""
+        max_ts = int(self.ts[: self.n].max(initial=0))
+        max_tx = int(self.tx[: self.n].max(initial=0))
+        spec.check(max_ts, len(self.interner), max_tx)
+        hi = np.where(self.valid, self.ts.astype(np.int32), I32_MAX)
+        lo = np.where(self.valid, spec.pack_lo(self.site, self.tx), I32_MAX)
+        return hi, lo
+
+    def cause_lanes(self, spec: PackSpec = DEFAULT_PACK):
+        """(hi, lo) lanes of each node's cause id, or (-1, -1) when the
+        cause is not an id (root sentinel, key causes, padding)."""
+        from ..ids import is_id
+
+        hi = np.full(self.capacity, -1, np.int32)
+        lo = np.full(self.capacity, -1, np.int32)
+        for i in range(self.n):
+            cause = self.nodes[i][1]
+            # any id-shaped cause, even one living in another replica's
+            # tree (merges resolve causes against the union)
+            if is_id(cause):
+                hi[i] = cause[0]
+                lo[i] = int(spec.pack_lo(np.int32(self.interner[cause[1]]),
+                                         np.int32(cause[2])))
+        return hi, lo

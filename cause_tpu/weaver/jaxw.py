@@ -1,0 +1,333 @@
+"""The JAX/TPU device weaver — the north-star kernel.
+
+The reference computes a weave by scanning nodes one at a time through
+``weave-node`` (shared.cljc:225-241, O(n) per insert, O(n^2) rebuild).
+On TPU we compute the *whole* linearization at once from the bag of
+nodes.
+
+**Order semantics** (derived from ``weave-asap?``/``weave-later?``,
+shared.cljc:194-223, and fuzz-verified against the pure weaver): the
+weave equals a chronological replay — processing nodes in ascending id
+order, a special node inserts immediately after its cause, and a
+non-special node inserts immediately before the first *non-special*
+node after its cause (i.e. it skips the whole run of specials sitting
+there). Two facts make that replay parallel:
+
+- no non-special ever lands *inside* a run of specials, so the
+  specials attached (via special-only cause chains) to a common
+  non-special **host** stay one contiguous block right after it, in
+  an order of their own that never changes; and
+- projected onto non-specials only, every node simply follows its
+  host, so the projection is a plain RGA order.
+
+Hence the weave is one preorder DFS of the derived tree T*:
+
+- special  -> parent is its cause;
+- non-special -> parent is its **host**: the first non-special node
+  on its cause chain (one pointer-doubling jump over special causes);
+- children sort specials-first, then descending id (so each node's
+  special block precedes its non-special children).
+
+The kernel is: the host pointer-jump, one ``lexsort`` to group
+children under T* parents in sibling order (the radix-sort reification
+of the predicates), an Euler tour over 2N edges, and pointer-doubling
+list ranking (ceil(log2 2N) gather rounds). Visibility (``hide?``,
+list.cljc:48-55) is one shifted compare on the final ranks. Everything
+is static-shape and jit/vmap-friendly; ``merge_weave_kernel`` unions
+two id-sorted node sets (packed-id sort + dedupe + searchsorted cause
+resolution) and reweaves — turning the reference's O(n*m) sequential
+merge (shared.cljc:300-314) into one data-parallel program, vmappable
+across thousands of replicas.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..collections.shared import CausalError
+from ..ids import node_from_kv
+from .arrays import (
+    DEFAULT_PACK,
+    I32_MAX,
+    NodeArrays,
+    PackSpec,
+    SiteInterner,
+    VCLASS_H_HIDE,
+    VCLASS_HIDE,
+    next_pow2,
+)
+
+__all__ = [
+    "linearize",
+    "weave_arrays",
+    "refresh_list_weave",
+    "merge_list_trees",
+    "merge_weave_kernel",
+    "batched_merge_weave",
+]
+
+
+def _child_sort(parent_sort, special, hi, lo):
+    """Group nodes under their parents in sibling order (specials first,
+    then descending id — ids compare as their (hi, lo) lanes). Returns
+    (first_child, next_sibling, last_special_child) as [N] node-index
+    arrays (-1 = none)."""
+    N = hi.shape[0]
+    not_special = (~special).astype(jnp.int32)
+    order = jnp.lexsort((-lo, -hi, not_special, parent_sort))
+    p = parent_sort[order]
+    spc = special[order]
+    is_start = jnp.concatenate([jnp.ones((1,), bool), p[1:] != p[:-1]])
+    same_parent_next = jnp.concatenate([p[1:] == p[:-1], jnp.zeros((1,), bool)])
+    succ_in_sort = jnp.concatenate([order[1:], jnp.zeros((1,), order.dtype)])
+    ns_sorted = jnp.where(same_parent_next, succ_in_sort, -1).astype(jnp.int32)
+    next_sibling = jnp.zeros(N, jnp.int32).at[order].set(ns_sorted)
+    ok_parent = (p >= 0) & (p < N)
+    fc_target = jnp.where(is_start & ok_parent, p, N)
+    first_child = (
+        jnp.full(N + 1, -1, jnp.int32).at[fc_target].set(order.astype(jnp.int32))[:N]
+    )
+    # last special child per parent: specials form each group's prefix,
+    # so it's the special lane whose successor leaves the group or is
+    # non-special.
+    spc_next = jnp.concatenate([spc[1:], jnp.zeros((1,), bool)])
+    is_last_special = spc & (~same_parent_next | ~spc_next)
+    ls_target = jnp.where(is_last_special & ok_parent, p, N)
+    last_special_child = (
+        jnp.full(N + 1, -1, jnp.int32).at[ls_target].set(order.astype(jnp.int32))[:N]
+    )
+    return first_child, next_sibling, last_special_child
+
+
+def _euler_rank(first_child, next_sibling, parent_up, valid):
+    """Preorder rank + subtree size via an Euler tour (2N edges:
+    d(i)=i, u(i)=N+i) and pointer-doubling suffix sums."""
+    N = first_child.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    up = N + idx
+    next_d = jnp.where(first_child >= 0, first_child, up)
+    next_u = jnp.where(
+        next_sibling >= 0,
+        next_sibling,
+        jnp.where(parent_up >= 0, N + parent_up, up),
+    )
+    nxt = jnp.concatenate([next_d, next_u])
+    w = jnp.concatenate([valid.astype(jnp.int32), jnp.zeros(N, jnp.int32)])
+
+    steps = max(1, math.ceil(math.log2(2 * N)))
+
+    def body(_, carry):
+        val, nx = carry
+        return val + val[nx], nx[nx]
+
+    val, _ = lax.fori_loop(0, steps, body, (w, nxt))
+    s_down = val[:N]   # valid nodes at-or-after d(i) in the tour
+    s_up = val[N:]     # valid nodes at-or-after u(i)
+    m = jnp.sum(valid.astype(jnp.int32))
+    rank = jnp.where(valid, m - s_down, N).astype(jnp.int32)
+    size = jnp.where(valid, s_down - s_up, 0).astype(jnp.int32)
+    return rank, size
+
+
+def _scatter_by_rank(rank, valid, N):
+    """node_at[pos] lookup table (size N+2; unwritten slots are -1)."""
+    idx = jnp.arange(N, dtype=jnp.int32)
+    return (
+        jnp.full(N + 2, -1, jnp.int32)
+        .at[jnp.where(valid, rank, N + 1)]
+        .set(idx)
+    )
+
+
+def linearize(hi, lo, cause_idx, vclass, valid):
+    """Weave position + visibility for one tree's node lanes.
+
+    ``hi``/``lo`` are the two int32 id lanes (see arrays.PackSpec).
+    Lane 0 must be the root sentinel (sorted-id layout guarantees it: no
+    real node id sorts below ``(0, "0", 0)``). Returns ``(rank,
+    visible)``: rank is the weave position (invalid lanes get N).
+    """
+    N = hi.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    is_root = valid & (idx == 0)
+    special = valid & (vclass > 0)
+
+    # ---- host jump: first non-special ancestor through the cause chain
+    # (pointer doubling over special causes; terminates at non-specials).
+    cause_safe = jnp.clip(cause_idx, 0, N - 1)
+    host = cause_safe
+    for _ in range(max(1, math.ceil(math.log2(N)))):
+        host = jnp.where(special[host], host[host], host)
+
+    # ---- the derived tree T*: specials under their cause, non-specials
+    # under their host; specials-first + descending-id sibling order.
+    parent_t = jnp.where(special, cause_safe, host)
+    parent_sort = jnp.where(valid & ~is_root, parent_t, N).astype(jnp.int32)
+    fc, ns, _ = _child_sort(parent_sort, special, hi, lo)
+    parent_up = jnp.where(valid & ~is_root, parent_t, -1)
+    rank, _size = _euler_rank(fc, ns, parent_up, valid)
+
+    # ---- visibility (hide?, list.cljc:48-55) via the weave successor.
+    node_at = _scatter_by_rank(rank, valid, N)
+    succ = node_at[jnp.clip(rank, 0, N) + 1]
+    succ_safe = jnp.clip(succ, 0, N - 1)
+    succ_is_hide = (
+        (succ >= 0)
+        & (
+            (vclass[succ_safe] == VCLASS_HIDE)
+            | (vclass[succ_safe] == VCLASS_H_HIDE)
+        )
+        & (cause_idx[succ_safe] == idx)
+    )
+    visible = valid & (vclass == 0) & ~is_root & ~succ_is_hide
+    return rank, visible
+
+
+_linearize_jit = jax.jit(linearize)
+
+
+def weave_arrays(na: NodeArrays) -> Tuple[np.ndarray, np.ndarray]:
+    """Run the device linearization for one tree; returns host-side
+    ``(rank, visible)`` numpy arrays."""
+    hi, lo = na.id_lanes()
+    rank, visible = _linearize_jit(
+        jnp.asarray(hi),
+        jnp.asarray(lo),
+        jnp.asarray(na.cause_idx),
+        jnp.asarray(na.vclass),
+        jnp.asarray(na.valid),
+    )
+    return np.asarray(rank), np.asarray(visible)
+
+
+def refresh_list_weave(ct):
+    """Full list-weave rebuild on device (the ``weaver="jax"`` path of
+    clist.weave). Produces the identical weave list the pure scan
+    would."""
+    na = NodeArrays.from_nodes_map(ct.nodes)
+    rank, _ = weave_arrays(na)
+    order = np.argsort(rank[: na.capacity], kind="stable")
+    weave = [na.nodes[i] for i in order[: na.n]]
+    return ct.evolve(weave=weave)
+
+
+def merge_list_trees(ct1, ct2):
+    """Device-backed merge: union the node stores host-side (dict merge
+    with the reference's append-only conflict check), then one batched
+    reweave on device — O((n+m) log) instead of the reference's O(n*m)
+    reduce-insert, with an identical resulting tree."""
+    if ct1.type != ct2.type:
+        raise CausalError(
+            "Causal type missmatch. Merge not allowed.",
+            {"causes": {"type-missmatch"}, "types": [ct1.type, ct2.type]},
+        )
+    if ct1.uuid != ct2.uuid:
+        raise CausalError(
+            "Causal UUID missmatch. Merge not allowed.",
+            {"causes": {"uuid-missmatch"}, "uuids": [ct1.uuid, ct2.uuid]},
+        )
+    nodes = dict(ct1.nodes)
+    max_new_ts = ct1.lamport_ts
+    for nid, body in ct2.nodes.items():
+        existing = nodes.get(nid)
+        if existing is not None:
+            if existing != body:
+                raise CausalError(
+                    "This node is already in the tree and can't be changed.",
+                    {"causes": {"append-only", "edits-not-allowed"},
+                     "existing_node": (nid,) + existing},
+                )
+            continue
+        if nid[0] > max_new_ts:
+            max_new_ts = nid[0]
+        nodes[nid] = body
+    from ..collections import shared as s
+
+    ct = ct1.evolve(nodes=nodes, lamport_ts=max_new_ts)
+    ct = s.spin(ct)
+    return refresh_list_weave(ct)
+
+
+# ------------------------- batched merge kernel -------------------------
+
+
+def merge_weave_kernel(hi, lo, cause_hi, cause_lo, vclass, valid):
+    """Union + reweave for one replica pair, fully on device.
+
+    Inputs are the *concatenated* (hi, lo) id lanes of two trees
+    (invalid lanes carry int32 max). Steps: lexsort by id, drop
+    duplicate ids (CRDT union — first occurrence wins; divergent bodies
+    under one id are reported via the conflict flag), resolve causes by
+    a sort-join (queries merged into the key order, forward-filled with
+    the last kept node lane via cummax), then linearize.
+
+    Returns ``(order, rank, visible, conflict)`` where ``order`` maps
+    sorted lanes back to input lanes, ``rank`` is each sorted lane's
+    weave position, ``visible`` the render mask, and ``conflict`` is
+    True iff two lanes shared an id with different (cause, vclass)
+    bodies (value payloads stay host-side; host equality still governs
+    the strict check on the API path).
+    """
+    M = hi.shape[0]
+    order = jnp.lexsort((lo, hi))
+    hi_s, lo_s = hi[order], lo[order]
+    dup = jnp.concatenate(
+        [jnp.zeros((1,), bool),
+         (hi_s[1:] == hi_s[:-1]) & (lo_s[1:] == lo_s[:-1])]
+    )
+    valid_s = valid[order]
+    keep = valid_s & ~dup
+    vclass_s = vclass[order]
+    chi_s, clo_s = cause_hi[order], cause_lo[order]
+    # conflict: a dropped duplicate whose lanes disagree
+    prev_chi = jnp.concatenate([chi_s[:1], chi_s[:-1]])
+    prev_clo = jnp.concatenate([clo_s[:1], clo_s[:-1]])
+    prev_vc = jnp.concatenate([vclass_s[:1], vclass_s[:-1]])
+    conflict = jnp.any(
+        dup
+        & valid_s
+        & ((chi_s != prev_chi) | (clo_s != prev_clo) | (vclass_s != prev_vc))
+    )
+    # ---- sort-join cause resolution: 2M records = kept node keys
+    # (kind 0) + per-lane cause queries (kind 1). After the lexsort each
+    # query directly follows the node records for its key; cummax over
+    # kept-node record positions forward-fills "the last kept node lane
+    # at or before me in key order".
+    rec_hi = jnp.concatenate([jnp.where(keep, hi_s, I32_MAX), chi_s])
+    rec_lo = jnp.concatenate([jnp.where(keep, lo_s, I32_MAX), clo_s])
+    rec_kind = jnp.concatenate(
+        [jnp.zeros(M, jnp.int32), jnp.ones(M, jnp.int32)]
+    )
+    ord2 = jnp.lexsort((rec_kind, rec_lo, rec_hi))
+    is_node_rec = (ord2 < M) & keep[jnp.clip(ord2, 0, M - 1)]
+    payload = jnp.where(is_node_rec, ord2.astype(jnp.int32), -1)
+    last_node = lax.cummax(payload)
+    last_safe = jnp.clip(last_node, 0, M - 1)
+    key_hi = jnp.concatenate([hi_s, chi_s])[ord2]
+    key_lo = jnp.concatenate([lo_s, clo_s])[ord2]
+    match = (
+        (last_node >= 0)
+        & (hi_s[last_safe] == key_hi)
+        & (lo_s[last_safe] == key_lo)
+    )
+    answer = jnp.where(match, last_node, -1)
+    q_lane = jnp.where(is_node_rec, 2 * M, ord2 - M)  # scatter-discard nodes
+    ci = (
+        jnp.full(2 * M + 1, -1, jnp.int32)
+        .at[q_lane]
+        .set(answer)[:M]
+    )
+    rank, visible = linearize(hi_s, lo_s, ci, vclass_s, keep)
+    return order, rank, visible, conflict
+
+
+# vmapped batch: [B, M] lanes -> per-replica weave ranks
+batched_merge_weave = jax.jit(jax.vmap(merge_weave_kernel))

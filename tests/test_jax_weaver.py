@@ -1,0 +1,161 @@
+"""Differential tests: JAX device weaver vs the pure host weaver.
+
+The core correctness strategy carried over from the reference (SURVEY.md
+§4): the pure weaver is the oracle; the device linearization must
+reproduce its weave node-for-node on the regression corpus, on random
+multi-site fuzz trees, and through merges.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import cause_tpu as c
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections import shared as s
+from cause_tpu.ids import new_site_id
+from cause_tpu.weaver import jaxw
+from cause_tpu.weaver.arrays import (
+    DEFAULT_PACK,
+    NodeArrays,
+    SiteInterner,
+)
+
+from test_list import EDGE_CASES, SIMPLE_VALUES, rand_node
+
+
+def pure_weave_of(ct):
+    return c_list.weave(ct.evolve(weaver="pure")).weave
+
+
+def jax_weave_of(ct):
+    return jaxw.refresh_list_weave(ct).weave
+
+
+@pytest.mark.parametrize("nodes", EDGE_CASES, ids=range(len(EDGE_CASES)))
+def test_regression_corpus_parity(nodes):
+    cl = c.clist()
+    for n in nodes:
+        cl = cl.insert(n)
+    assert jax_weave_of(cl.ct) == pure_weave_of(cl.ct)
+
+
+def test_empty_and_tiny_trees():
+    cl = c.clist()
+    assert jax_weave_of(cl.ct) == pure_weave_of(cl.ct)
+    cl = c.clist("a")
+    assert jax_weave_of(cl.ct) == pure_weave_of(cl.ct)
+
+
+def test_fuzz_parity():
+    rng = random.Random(0xBEEF)
+    for round_ in range(60):
+        site_ids = [new_site_id() for _ in range(5)]
+        cl = c.clist()
+        for _ in range(rng.randrange(1, 15)):
+            cl = cl.insert(rand_node(rng, cl, site_id=rng.choice(site_ids)))
+        assert jax_weave_of(cl.ct) == pure_weave_of(cl.ct), (
+            f"divergence in round {round_}: nodes={sorted(cl.ct.nodes)}"
+        )
+
+
+def test_jax_weaver_end_to_end():
+    """weaver="jax" trees behave identically through the public API."""
+    cl = c.clist("h", "e", "y", weaver="jax")
+    assert cl.causal_to_edn() == ["h", "e", "y"]
+    refreshed = s.refresh_caches(c_list.weave, cl.ct)
+    assert refreshed.weave == cl.ct.weave
+
+
+def test_merge_parity():
+    rng = random.Random(99)
+    for _ in range(20):
+        base = c.clist(*"seed")
+        replicas = []
+        for _ in range(2):
+            r = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
+            for _ in range(rng.randrange(1, 8)):
+                r = r.insert(rand_node(rng, r, site_id=r.ct.site_id))
+            replicas.append(r)
+        pure_merged = s.merge_trees(c_list.weave, replicas[0].ct, replicas[1].ct)
+        jax_merged = jaxw.merge_list_trees(replicas[0].ct, replicas[1].ct)
+        assert jax_merged.nodes == pure_merged.nodes
+        assert jax_merged.yarns == pure_merged.yarns
+        assert jax_merged.lamport_ts == pure_merged.lamport_ts
+        assert jax_merged.weave == pure_merged.weave
+
+
+def test_merge_conflict_raises():
+    a = c.clist()
+    nid = (1, "siteA________Z", 0)
+    a2 = a.insert((nid, c.root_id, "x"))
+    b2 = c_list.CausalList(a.ct).insert((nid, c.root_id, "y"))
+    with pytest.raises(c.CausalError):
+        jaxw.merge_list_trees(a2.ct, b2.ct)
+
+
+def _tree_lanes(ct, interner, capacity):
+    na = NodeArrays.from_nodes_map(ct.nodes, capacity=capacity, interner=interner)
+    hi, lo = na.id_lanes()
+    chi, clo = na.cause_lanes()
+    return na, (hi, lo), (chi, clo)
+
+
+def test_batched_merge_kernel_parity():
+    """The fully-on-device union kernel agrees with pure pairwise merge."""
+    rng = random.Random(2024)
+    B = 4
+    pairs = []
+    sites = set()
+    for _ in range(B):
+        base = c.clist(*"ab")
+        a = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
+        bb = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
+        for _ in range(5):
+            a = a.insert(rand_node(rng, a, site_id=a.ct.site_id))
+            bb = bb.insert(rand_node(rng, bb, site_id=bb.ct.site_id))
+        pairs.append((a.ct, bb.ct))
+        sites |= {i[1] for i in a.ct.nodes} | {i[1] for i in bb.ct.nodes}
+    interner = SiteInterner(sites)
+    cap = 32
+    lanes = {k: [] for k in ("hi", "lo", "chi", "clo", "vc", "valid")}
+    metas = []
+    for a_ct, b_ct in pairs:
+        na, (ahi, alo), (achi, aclo) = _tree_lanes(a_ct, interner, cap)
+        nb, (bhi, blo), (bchi, bclo) = _tree_lanes(b_ct, interner, cap)
+        lanes["hi"].append(np.concatenate([ahi, bhi]))
+        lanes["lo"].append(np.concatenate([alo, blo]))
+        lanes["chi"].append(np.concatenate([achi, bchi]))
+        lanes["clo"].append(np.concatenate([aclo, bclo]))
+        lanes["vc"].append(np.concatenate([na.vclass, nb.vclass]))
+        lanes["valid"].append(np.concatenate([na.valid, nb.valid]))
+        metas.append((na, nb))
+    stack = {k: np.stack(v) for k, v in lanes.items()}
+    order, rank, visible, conflict = jaxw.batched_merge_weave(
+        stack["hi"], stack["lo"], stack["chi"], stack["clo"],
+        stack["vc"], stack["valid"],
+    )
+    order, rank, visible, conflict = map(np.asarray, (order, rank, visible, conflict))
+    assert not conflict.any()
+    for bidx, (a_ct, b_ct) in enumerate(pairs):
+        na, nb = metas[bidx]
+        all_nodes = na.nodes + [None] * (cap - na.n) + nb.nodes + [None] * (cap - nb.n)
+        lane_nodes = [all_nodes[i] for i in order[bidx]]
+        m = sum(1 for r in rank[bidx] if r < 2 * cap)
+        # device weave: sorted lanes ordered by rank, masked lanes dropped
+        woven = [None] * (2 * cap)
+        vis_sorted = visible[bidx]
+        out, vis_nodes = {}, []
+        for lane, r in enumerate(rank[bidx]):
+            if r < 2 * cap and lane_nodes[lane] is not None:
+                out[int(r)] = lane_nodes[lane]
+                if vis_sorted[lane]:
+                    vis_nodes.append((int(r), lane_nodes[lane]))
+        device_weave = [out[r] for r in sorted(out)]
+        pure_merged = s.merge_trees(c_list.weave, a_ct, b_ct)
+        assert device_weave == pure_merged.weave, f"pair {bidx}"
+        # visibility parity
+        vis_nodes.sort()
+        expect_visible = c_list.causal_list_to_list(pure_merged)
+        assert [n for _, n in vis_nodes] == expect_visible, f"pair {bidx}"

@@ -1,0 +1,125 @@
+"""Multi-chip tests on the 8-virtual-device CPU mesh (conftest forces
+xla_force_host_platform_device_count=8)."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+import cause_tpu as c
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections import shared as s
+from cause_tpu.ids import new_site_id
+from cause_tpu.parallel import make_mesh, sharded_merge_weave
+from cause_tpu.weaver.arrays import NodeArrays, SiteInterner
+
+from test_list import rand_node
+from test_jax_weaver import _tree_lanes
+
+
+def _require_multi_device():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the forced multi-device CPU platform")
+
+
+def _build_batch(rng, B, cap):
+    """B divergent replica pairs sharing one base, as stacked lanes."""
+    pairs = []
+    sites = set()
+    for _ in range(B):
+        base = c.clist(*"ab")
+        a = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
+        bb = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
+        for _ in range(4):
+            a = a.insert(rand_node(rng, a, site_id=a.ct.site_id))
+            bb = bb.insert(rand_node(rng, bb, site_id=bb.ct.site_id))
+        pairs.append((a.ct, bb.ct))
+        sites |= {i[1] for i in a.ct.nodes} | {i[1] for i in bb.ct.nodes}
+    interner = SiteInterner(sites)
+    lanes = {k: [] for k in ("hi", "lo", "chi", "clo", "vc", "valid")}
+    for a_ct, b_ct in pairs:
+        na, (ahi, alo), (achi, aclo) = _tree_lanes(a_ct, interner, cap)
+        nb, (bhi, blo), (bchi, bclo) = _tree_lanes(b_ct, interner, cap)
+        lanes["hi"].append(np.concatenate([ahi, bhi]))
+        lanes["lo"].append(np.concatenate([alo, blo]))
+        lanes["chi"].append(np.concatenate([achi, bchi]))
+        lanes["clo"].append(np.concatenate([aclo, bclo]))
+        lanes["vc"].append(np.concatenate([na.vclass, nb.vclass]))
+        lanes["valid"].append(np.concatenate([na.valid, nb.valid]))
+    return pairs, {k: np.stack(v) for k, v in lanes.items()}
+
+
+def test_mesh_has_8_virtual_devices():
+    assert len(jax.devices()) == 8
+
+
+def test_sharded_merge_matches_pure():
+    _require_multi_device()
+    rng = random.Random(5150)
+    n_dev = len(jax.devices())
+    B = n_dev * 2
+    cap = 16
+    mesh = make_mesh()
+    pairs, lanes = _build_batch(rng, B, cap)
+    order, rank, visible, digest, total_visible, n_conflicts = (
+        sharded_merge_weave(
+            mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
+            lanes["vc"], lanes["valid"],
+        )
+    )
+    order, rank, visible = map(np.asarray, (order, rank, visible))
+    assert int(n_conflicts) == 0
+    expect_total = 0
+    for bidx, (a_ct, b_ct) in enumerate(pairs):
+        pure = s.merge_trees(c_list.weave, a_ct, b_ct)
+        expect_visible = c_list.causal_list_to_list(pure)
+        expect_total += len(expect_visible)
+        # reconstruct device weave for this replica
+        na_nodes = sorted(a_ct.nodes)
+        all_nodes = (
+            [(nid,) + tuple(a_ct.nodes[nid]) for nid in sorted(a_ct.nodes)]
+            + [None] * (cap - len(a_ct.nodes))
+            + [(nid,) + tuple(b_ct.nodes[nid]) for nid in sorted(b_ct.nodes)]
+            + [None] * (cap - len(b_ct.nodes))
+        )
+        out = {}
+        for lane, r in enumerate(rank[bidx]):
+            if r < 2 * cap:
+                out[int(r)] = all_nodes[order[bidx][lane]]
+        device_weave = [out[r] for r in sorted(out)]
+        assert device_weave == pure.weave, f"replica {bidx}"
+    assert int(total_visible) == expect_total
+
+
+def test_digests_detect_convergence():
+    _require_multi_device()
+    rng = random.Random(6)
+    n_dev = len(jax.devices())
+    B = n_dev
+    cap = 16
+    mesh = make_mesh()
+    # identical pairs in every batch slot -> identical digests
+    base = c.clist(*"xyz")
+    a = c_list.CausalList(base.ct.evolve(site_id=new_site_id())).conj("!")
+    bb = c_list.CausalList(base.ct.evolve(site_id=new_site_id())).cons("?")
+    sites = {i[1] for i in a.ct.nodes} | {i[1] for i in bb.ct.nodes}
+    interner = SiteInterner(sites)
+    na, (ahi, alo), (achi, aclo) = _tree_lanes(a.ct, interner, cap)
+    nb, (bhi, blo), (bchi, bclo) = _tree_lanes(bb.ct, interner, cap)
+    row = {
+        "hi": np.concatenate([ahi, bhi]),
+        "lo": np.concatenate([alo, blo]),
+        "chi": np.concatenate([achi, bchi]),
+        "clo": np.concatenate([aclo, bclo]),
+        "vc": np.concatenate([na.vclass, nb.vclass]),
+        "valid": np.concatenate([na.valid, nb.valid]),
+    }
+    lanes = {k: np.stack([v] * B) for k, v in row.items()}
+    *_, digest, _total, _conf = sharded_merge_weave(
+        mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
+        lanes["vc"], lanes["valid"],
+    )
+    digest = np.asarray(digest)
+    assert (digest == digest[0]).all()
