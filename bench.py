@@ -1,0 +1,86 @@
+"""North-star benchmark: batched merge of divergent 10k-node CausalLists
+across 1024 replica pairs on one chip (BASELINE.json config 5).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
+value is the p50 wall latency of the full batched merge+weave program
+(union, cause resolution, linearization, visibility) and vs_baseline is
+the north-star target (100 ms) divided by the measured p50 — >1.0 means
+the target is beaten.
+
+Timing note: on the axon-tunneled TPU, ``jax.block_until_ready`` does
+not actually block, so the timed program reduces its outputs to one
+scalar and the harness forces a device->host transfer of that scalar —
+the only reliable sync point. The reduction cost is noise next to the
+merge itself.
+
+Run on whatever jax.devices() offers (TPU under the driver; CPU works
+for smoke tests via BENCH_SMOKE=1).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cause_tpu import benchgen
+from cause_tpu.weaver.jaxw import merge_weave_kernel
+
+NORTH_STAR_MS = 100.0
+
+
+@jax.jit
+def _merge_wave_scalar(hi, lo, chi, clo, vc, valid):
+    """The timed program: the full batched merge+weave, reduced to one
+    checksum scalar so timing needs only a 4-byte transfer."""
+    order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(
+        hi, lo, chi, clo, vc, valid
+    )
+    return (
+        jnp.sum(rank.astype(jnp.float32))
+        + jnp.sum(order.astype(jnp.float32))
+        + jnp.sum(visible.astype(jnp.float32))
+        + jnp.sum(conflict.astype(jnp.float32))
+    )
+
+
+def main() -> None:
+    smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+    if smoke:
+        B, n_base, n_div, cap, reps = 8, 800, 100, 1024, 3
+    else:
+        # 10k-node lists: 9k shared base + 1k divergent suffix per side
+        # (tombstones every 8th suffix node), 1024 replica pairs.
+        B, n_base, n_div, cap, reps = 1024, 9_000, 1_000, 10_240, 3
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=n_base, n_div=n_div, capacity=cap, hide_every=8
+    )
+    args = [jax.device_put(batch[k]) for k in ("hi", "lo", "chi", "clo", "vc", "valid")]
+
+    # compile + warmup (float() forces execution through the tunnel)
+    checksum = float(_merge_wave_scalar(*args))
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(_merge_wave_scalar(*args))
+        times.append((time.perf_counter() - t0) * 1000.0)
+    p50 = float(np.median(times))
+
+    print(json.dumps({
+        "metric": f"p50 batched merge+weave, {B} replica pairs x "
+                  f"{1 + n_base + n_div}-node CausalLists",
+        "value": round(p50, 3),
+        "unit": "ms",
+        "vs_baseline": round(NORTH_STAR_MS / p50, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

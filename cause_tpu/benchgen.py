@@ -1,0 +1,132 @@
+"""Synthetic divergent-replica generators for benchmarks and dry runs.
+
+Builds the *lane-level* inputs of the batched merge kernel
+(``weaver.jaxw.merge_weave_kernel``) directly as numpy arrays — the
+north-star benchmark merges 1024 replica pairs of 10k-node CausalLists
+(BASELINE.json config 5), and minting 20M nodes through the host CRDT
+API would measure Python, not the TPU. The generated lanes are exactly
+what ``NodeArrays`` would produce for real trees of the same shape
+(fuzz-verified in tests/test_benchgen.py):
+
+- a shared **base chain**: an append-only run of ``n_base`` nodes from
+  one site (ids ``(i, base_site, 0)`` causing their predecessor — what
+  ``clist.conj`` mints, reference: list.cljc:36-40);
+- per replica pair, two **divergent suffixes** of ``n_div`` nodes from
+  two fresh sites, each continuing the chain from the base tail, with
+  every ``hide_every``-th suffix node a ``hide`` tombstone targeting
+  its predecessor (reference tombstone semantics, list.cljc:48-55).
+
+Site-id strings never exist here: sites are materialized directly as
+order-preserving ranks (root "0" < base < suffix-A < suffix-B), the
+same contract ``SiteInterner`` enforces for real trees.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .weaver.arrays import (
+    DEFAULT_PACK,
+    I32_MAX,
+    PackSpec,
+    VCLASS_HIDE,
+    VCLASS_NORMAL,
+)
+
+__all__ = ["chain_tree_lanes", "divergent_pair_lanes", "batched_pair_lanes"]
+
+# synthetic site ranks (order-preserving: "0" sorts first, suffix sites
+# are minted after and sort above the base site by construction)
+SITE_ROOT = 0
+SITE_BASE = 1
+SITE_A = 2
+SITE_B = 3
+
+
+def chain_tree_lanes(
+    n_base: int,
+    n_div: int,
+    suffix_site: int,
+    capacity: int,
+    hide_every: int = 0,
+    spec: PackSpec = DEFAULT_PACK,
+) -> Dict[str, np.ndarray]:
+    """Lanes for ONE tree: root + base chain + one divergent suffix.
+
+    Lanes come out in sorted id order (ts is strictly increasing along
+    the chain), root at lane 0 — the ``NodeArrays.from_nodes_map``
+    layout. Returns hi/lo (id lanes), chi/clo (cause id lanes), vc,
+    valid, each of length ``capacity``.
+    """
+    n = 1 + n_base + n_div
+    if capacity < n:
+        raise ValueError(f"capacity {capacity} < node count {n}")
+    ts = np.zeros(n, np.int64)
+    site = np.zeros(n, np.int64)
+    vc = np.zeros(n, np.int32)
+
+    # base chain: ts 1..n_base, all from SITE_BASE
+    ts[1 : 1 + n_base] = np.arange(1, n_base + 1)
+    site[1 : 1 + n_base] = SITE_BASE
+    # divergent suffix: ts n_base+1 .., from suffix_site
+    ts[1 + n_base :] = np.arange(n_base + 1, n_base + n_div + 1)
+    site[1 + n_base :] = suffix_site
+
+    # causes: chain — node i caused by node i-1 (root causes itself as
+    # a placeholder; its cause lanes are (-1,-1) below)
+    cts = np.concatenate([[0], ts[:-1]])
+    csite = np.concatenate([[0], site[:-1]])
+
+    if hide_every > 0:
+        # every k-th suffix node is a hide targeting its predecessor
+        j = np.arange(1, n_div + 1)
+        is_hide = (j % hide_every) == 0
+        vc[1 + n_base :][is_hide] = VCLASS_HIDE
+
+    tx = np.zeros(n, np.int64)
+    hi = np.full(capacity, I32_MAX, np.int32)
+    lo = np.full(capacity, I32_MAX, np.int32)
+    chi = np.full(capacity, -1, np.int32)
+    clo = np.full(capacity, -1, np.int32)
+    vcl = np.zeros(capacity, np.int32)
+    valid = np.zeros(capacity, bool)
+
+    hi[:n] = ts.astype(np.int32)
+    lo[:n] = (site.astype(np.int32) << spec.tx_bits) | tx.astype(np.int32)[:n]
+    chi[1:n] = cts[1:].astype(np.int32)
+    clo[1:n] = (csite[1:].astype(np.int32) << spec.tx_bits)
+    vcl[:n] = vc
+    valid[:n] = True
+    return {"hi": hi, "lo": lo, "chi": chi, "clo": clo, "vc": vcl, "valid": valid}
+
+
+def divergent_pair_lanes(
+    n_base: int,
+    n_div: int,
+    capacity: int,
+    hide_every: int = 0,
+) -> Dict[str, np.ndarray]:
+    """Concatenated lanes ([2*capacity]) of one divergent replica pair —
+    the per-replica input of ``merge_weave_kernel``."""
+    a = chain_tree_lanes(n_base, n_div, SITE_A, capacity, hide_every)
+    b = chain_tree_lanes(n_base, n_div, SITE_B, capacity, hide_every)
+    return {k: np.concatenate([a[k], b[k]]) for k in a}
+
+
+def batched_pair_lanes(
+    n_replicas: int,
+    n_base: int,
+    n_div: int,
+    capacity: int,
+    hide_every: int = 0,
+) -> Dict[str, np.ndarray]:
+    """The [B, 2*capacity] batch for ``batched_merge_weave`` /
+    ``sharded_merge_weave``: ``n_replicas`` divergent pairs. Rows are
+    identical in structure (XLA's work per row does not depend on lane
+    values), so the batch is a broadcast — cheap to build at B=1024."""
+    row = divergent_pair_lanes(n_base, n_div, capacity, hide_every)
+    return {
+        k: np.broadcast_to(v, (n_replicas,) + v.shape).copy() for k, v in row.items()
+    }

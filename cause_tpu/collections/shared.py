@@ -49,6 +49,7 @@ __all__ = [
     "yarns_to_nodes",
     "refresh_caches",
     "weft",
+    "check_mergeable",
     "merge_trees",
     "causal_to_edn",
 ]
@@ -237,6 +238,21 @@ def weft(weave_fn: WeaveFn, new_causal_tree_fn: Callable[[], CausalTree],
     return weave_fn(new_ct)
 
 
+def check_mergeable(ct1: CausalTree, ct2: CausalTree) -> None:
+    """Merge guards shared by the pure and device merge paths: type and
+    uuid must match (shared.cljc:303-311)."""
+    if ct1.type != ct2.type:
+        raise CausalError(
+            "Causal type missmatch. Merge not allowed.",
+            {"causes": {"type-missmatch"}, "types": [ct1.type, ct2.type]},
+        )
+    if ct1.uuid != ct2.uuid:
+        raise CausalError(
+            "Causal UUID missmatch. Merge not allowed.",
+            {"causes": {"uuid-missmatch"}, "uuids": [ct1.uuid, ct2.uuid]},
+        )
+
+
 def merge_trees(weave_fn: WeaveFn, ct1: CausalTree, ct2: CausalTree) -> CausalTree:
     """Merge two causal trees into one (shared.cljc:300-314).
 
@@ -249,16 +265,7 @@ def merge_trees(weave_fn: WeaveFn, ct1: CausalTree, ct2: CausalTree) -> CausalTr
     With ``weaver="jax"`` the merge is instead union + one batched
     device reweave (see cause_tpu.weaver.jaxw), the north-star path.
     """
-    if ct1.type != ct2.type:
-        raise CausalError(
-            "Causal type missmatch. Merge not allowed.",
-            {"causes": {"type-missmatch"}, "types": [ct1.type, ct2.type]},
-        )
-    if ct1.uuid != ct2.uuid:
-        raise CausalError(
-            "Causal UUID missmatch. Merge not allowed.",
-            {"causes": {"uuid-missmatch"}, "uuids": [ct1.uuid, ct2.uuid]},
-        )
+    check_mergeable(ct1, ct2)
     for nid in sorted(ct2.nodes):
         ct1 = insert(weave_fn, ct1, node_from_kv((nid, ct2.nodes[nid])))
     return ct1

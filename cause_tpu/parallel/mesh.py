@@ -19,15 +19,15 @@ merge wave.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import Optional, Tuple
+from functools import lru_cache, partial
+from typing import Optional
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..weaver.jaxw import merge_weave_kernel
 
@@ -56,27 +56,28 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = REPLICA_AXIS) -> Mesh
     return Mesh(np.array(devs), (axis,))
 
 
-def replica_digest(rank, visible):
+def replica_digest(hi_sorted, lo_sorted, rank, visible):
     """An order-sensitive digest of one replica's weave: replicas that
-    converged to the same linearization get the same digest. Cheap
-    stand-in for shipping whole weaves around when checking fleet
-    convergence."""
+    converged to the same linearization get the same digest, whatever
+    lane order their inputs arrived in (node identity and weave
+    position are mixed, lane positions are not). Cheap stand-in for
+    shipping whole weaves around when checking fleet convergence."""
     m = rank.shape[0]
-    pos = jnp.where(rank < m, rank.astype(jnp.uint32), jnp.uint32(0))
+    kept = rank < m
+    pos = jnp.where(kept, rank.astype(jnp.uint32), jnp.uint32(0))
     vis = visible.astype(jnp.uint32)
-    mix = pos * jnp.uint32(2654435761) + vis * jnp.uint32(40503) + jnp.uint32(1)
-    salt = jnp.arange(m, dtype=jnp.uint32) * jnp.uint32(0x9E3779B1)
-    return jnp.sum(jnp.where(rank < m, mix ^ salt, jnp.uint32(0)))
+    mix = (
+        hi_sorted.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+        ^ lo_sorted.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+        ^ (pos * jnp.uint32(2654435761) + vis * jnp.uint32(40503) + jnp.uint32(1))
+    )
+    return jnp.sum(jnp.where(kept, mix, jnp.uint32(0)))
 
 
-def sharded_merge_weave(mesh: Mesh, hi, lo, cause_hi, cause_lo, vclass, valid):
-    """Run the batched merge+weave with the replica axis sharded over
-    the mesh. Returns per-replica ``(order, rank, visible, digest)``
-    (sharded) plus fleet-level ``(total_visible, n_conflicts)`` reduced
-    with psum over the mesh axis.
-
-    The batch dimension must be divisible by the mesh size.
-    """
+@lru_cache(maxsize=8)
+def _sharded_step(mesh: Mesh):
+    """The jitted sharded merge step for one mesh (cached so repeat
+    merge waves hit the jit cache instead of re-tracing)."""
     axis = mesh.axis_names[0]
     sharded = P(axis)
     replicated = P()
@@ -91,9 +92,22 @@ def sharded_merge_weave(mesh: Mesh, hi, lo, cause_hi, cause_lo, vclass, valid):
         order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(
             hi, lo, chi, clo, vc, va
         )
-        digest = jax.vmap(replica_digest)(rank, visible)
+        hi_sorted = jnp.take_along_axis(hi, order, axis=1)
+        lo_sorted = jnp.take_along_axis(lo, order, axis=1)
+        digest = jax.vmap(replica_digest)(hi_sorted, lo_sorted, rank, visible)
         total_visible = lax.psum(jnp.sum(visible.astype(jnp.int32)), axis)
         n_conflicts = lax.psum(jnp.sum(conflict.astype(jnp.int32)), axis)
         return order, rank, visible, digest, total_visible, n_conflicts
 
-    return jax.jit(step)(hi, lo, cause_hi, cause_lo, vclass, valid)
+    return jax.jit(step)
+
+
+def sharded_merge_weave(mesh: Mesh, hi, lo, cause_hi, cause_lo, vclass, valid):
+    """Run the batched merge+weave with the replica axis sharded over
+    the mesh. Returns per-replica ``(order, rank, visible, digest)``
+    (sharded) plus fleet-level ``(total_visible, n_conflicts)`` reduced
+    with psum over the mesh axis.
+
+    The batch dimension must be divisible by the mesh size.
+    """
+    return _sharded_step(mesh)(hi, lo, cause_hi, cause_lo, vclass, valid)

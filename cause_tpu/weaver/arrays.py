@@ -25,7 +25,7 @@ silently downcasts int64, and TPUs prefer 32-bit lanes anyway.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -71,17 +71,20 @@ def next_pow2(n: int) -> int:
 class PackSpec:
     """Bit layout for the (hi, lo) id lanes: ``hi = ts`` (int32) and
     ``lo = (site_rank << tx_bits) | tx`` (int32). Defaults allow
-    ts < 2^31, 2^18 sites, tx < 2^13 (31 bits in lo); ``check`` raises
-    before any silent wraparound. Lexicographic (hi, lo) order equals
-    id order."""
+    ts < 2^31-1, < 2^18 sites, tx < 2^13 (31 bits in lo); ``check``
+    raises before any silent wraparound and reserves the all-ones
+    packings for the I32_MAX padding sentinel. Lexicographic (hi, lo)
+    order equals id order."""
 
     site_bits: int = 18
     tx_bits: int = 13
 
     def check(self, max_ts: int, n_sites: int, max_tx: int) -> None:
-        if max_ts >= (1 << 31):
-            raise OverflowError(f"lamport-ts {max_ts} exceeds 31 bits")
-        if n_sites > (1 << self.site_bits):
+        # strict: the all-ones packings are reserved for the I32_MAX
+        # padding sentinel, so a maximal real id must never reach them
+        if max_ts >= (1 << 31) - 1:
+            raise OverflowError(f"lamport-ts {max_ts} reaches the padding sentinel")
+        if n_sites >= (1 << self.site_bits):
             raise OverflowError(f"{n_sites} sites exceed {self.site_bits} bits")
         if max_tx >= (1 << self.tx_bits):
             raise OverflowError(f"tx-index {max_tx} exceeds {self.tx_bits} bits")

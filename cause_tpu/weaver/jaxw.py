@@ -43,8 +43,7 @@ across thousands of replicas.
 from __future__ import annotations
 
 import math
-from functools import partial
-from typing import Optional, Tuple
+from typing import Tuple
 
 import numpy as np
 
@@ -52,17 +51,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..collections.shared import CausalError
-from ..ids import node_from_kv
+from ..collections.shared import CausalError, check_mergeable
 from .arrays import (
-    DEFAULT_PACK,
     I32_MAX,
     NodeArrays,
-    PackSpec,
-    SiteInterner,
     VCLASS_H_HIDE,
     VCLASS_HIDE,
-    next_pow2,
 )
 
 __all__ = [
@@ -78,13 +72,11 @@ __all__ = [
 def _child_sort(parent_sort, special, hi, lo):
     """Group nodes under their parents in sibling order (specials first,
     then descending id — ids compare as their (hi, lo) lanes). Returns
-    (first_child, next_sibling, last_special_child) as [N] node-index
-    arrays (-1 = none)."""
+    (first_child, next_sibling) as [N] node-index arrays (-1 = none)."""
     N = hi.shape[0]
     not_special = (~special).astype(jnp.int32)
     order = jnp.lexsort((-lo, -hi, not_special, parent_sort))
     p = parent_sort[order]
-    spc = special[order]
     is_start = jnp.concatenate([jnp.ones((1,), bool), p[1:] != p[:-1]])
     same_parent_next = jnp.concatenate([p[1:] == p[:-1], jnp.zeros((1,), bool)])
     succ_in_sort = jnp.concatenate([order[1:], jnp.zeros((1,), order.dtype)])
@@ -95,16 +87,7 @@ def _child_sort(parent_sort, special, hi, lo):
     first_child = (
         jnp.full(N + 1, -1, jnp.int32).at[fc_target].set(order.astype(jnp.int32))[:N]
     )
-    # last special child per parent: specials form each group's prefix,
-    # so it's the special lane whose successor leaves the group or is
-    # non-special.
-    spc_next = jnp.concatenate([spc[1:], jnp.zeros((1,), bool)])
-    is_last_special = spc & (~same_parent_next | ~spc_next)
-    ls_target = jnp.where(is_last_special & ok_parent, p, N)
-    last_special_child = (
-        jnp.full(N + 1, -1, jnp.int32).at[ls_target].set(order.astype(jnp.int32))[:N]
-    )
-    return first_child, next_sibling, last_special_child
+    return first_child, next_sibling
 
 
 def _euler_rank(first_child, next_sibling, parent_up, valid):
@@ -171,7 +154,7 @@ def linearize(hi, lo, cause_idx, vclass, valid):
     # under their host; specials-first + descending-id sibling order.
     parent_t = jnp.where(special, cause_safe, host)
     parent_sort = jnp.where(valid & ~is_root, parent_t, N).astype(jnp.int32)
-    fc, ns, _ = _child_sort(parent_sort, special, hi, lo)
+    fc, ns = _child_sort(parent_sort, special, hi, lo)
     parent_up = jnp.where(valid & ~is_root, parent_t, -1)
     rank, _size = _euler_rank(fc, ns, parent_up, valid)
 
@@ -224,16 +207,7 @@ def merge_list_trees(ct1, ct2):
     with the reference's append-only conflict check), then one batched
     reweave on device — O((n+m) log) instead of the reference's O(n*m)
     reduce-insert, with an identical resulting tree."""
-    if ct1.type != ct2.type:
-        raise CausalError(
-            "Causal type missmatch. Merge not allowed.",
-            {"causes": {"type-missmatch"}, "types": [ct1.type, ct2.type]},
-        )
-    if ct1.uuid != ct2.uuid:
-        raise CausalError(
-            "Causal UUID missmatch. Merge not allowed.",
-            {"causes": {"uuid-missmatch"}, "uuids": [ct1.uuid, ct2.uuid]},
-        )
+    check_mergeable(ct1, ct2)
     nodes = dict(ct1.nodes)
     max_new_ts = ct1.lamport_ts
     for nid, body in ct2.nodes.items():

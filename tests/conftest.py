@@ -16,4 +16,12 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The driver image pre-imports jax via sitecustomize with JAX_PLATFORMS=axon,
+# so the env vars above arrive too late for the import-time default. The
+# backend itself is lazily initialized, so flipping the config here (before
+# any jax.devices()/jit call) still lands us on the 8-device CPU mesh.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,87 @@
+"""The synthetic bench generator must describe *real* trees: kernel
+output on benchgen lanes == pure host merge of the equivalent trees
+built through the public API."""
+
+import numpy as np
+
+import cause_tpu as c
+from cause_tpu import benchgen as bg
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections import shared as s
+from cause_tpu.weaver import jaxw
+
+# site-id strings whose sorted order matches the synthetic ranks
+# (root "0" < base < A < B)
+SITE_STRS = {bg.SITE_BASE: "site1base____", bg.SITE_A: "site2a_______",
+             bg.SITE_B: "site3b_______"}
+
+
+def build_real_pair(n_base, n_div, hide_every=0):
+    """The trees benchgen's lanes claim to describe, via the host API."""
+    base = c_list.CausalList(
+        c_list.new_causal_tree().evolve(site_id=SITE_STRS[bg.SITE_BASE])
+    )
+    for i in range(1, n_base + 1):
+        cause = c.root_id if i == 1 else (i - 1, SITE_STRS[bg.SITE_BASE], 0)
+        base = base.insert(((i, SITE_STRS[bg.SITE_BASE], 0), cause, f"b{i}"))
+
+    def suffixed(site_rank):
+        site = SITE_STRS[site_rank]
+        t = c_list.CausalList(base.ct.evolve(site_id=site))
+        prev = (
+            (n_base, SITE_STRS[bg.SITE_BASE], 0) if n_base else c.root_id
+        )
+        for j in range(1, n_div + 1):
+            ts = n_base + j
+            val = c.hide if (hide_every and j % hide_every == 0) else f"v{j}"
+            t = t.insert(((ts, site, 0), prev, val))
+            prev = (ts, site, 0)
+        return t
+
+    return suffixed(bg.SITE_A), suffixed(bg.SITE_B)
+
+
+def kernel_weave(lanes, cap, a_ct, b_ct):
+    """Decode merge_weave_kernel output back to a host node weave."""
+    order, rank, visible, conflict = jaxw.merge_weave_kernel(
+        *(lanes[k] for k in ("hi", "lo", "chi", "clo", "vc", "valid"))
+    )
+    order, rank = np.asarray(order), np.asarray(rank)
+    assert not bool(conflict)
+    all_nodes = (
+        [(nid,) + tuple(a_ct.nodes[nid]) for nid in sorted(a_ct.nodes)]
+        + [None] * (cap - len(a_ct.nodes))
+        + [(nid,) + tuple(b_ct.nodes[nid]) for nid in sorted(b_ct.nodes)]
+        + [None] * (cap - len(b_ct.nodes))
+    )
+    out = {}
+    for lane, r in enumerate(rank):
+        if r < 2 * cap:
+            out[int(r)] = all_nodes[order[lane]]
+    return [out[r] for r in sorted(out)]
+
+
+def check_config(n_base, n_div, hide_every, cap):
+    lanes = bg.divergent_pair_lanes(n_base, n_div, cap, hide_every)
+    a, b = build_real_pair(n_base, n_div, hide_every)
+    got = kernel_weave(lanes, cap, a.ct, b.ct)
+    expect = s.merge_trees(c_list.weave, a.ct, b.ct).weave
+    assert got == expect
+
+
+def test_parity_append_only():
+    check_config(n_base=6, n_div=4, hide_every=0, cap=16)
+
+
+def test_parity_with_tombstones():
+    check_config(n_base=5, n_div=6, hide_every=3, cap=16)
+
+
+def test_parity_no_base():
+    check_config(n_base=0, n_div=5, hide_every=2, cap=8)
+
+
+def test_batched_shape():
+    batch = bg.batched_pair_lanes(4, 3, 2, 8, hide_every=0)
+    assert batch["hi"].shape == (4, 16)
+    assert all(v.shape[0] == 4 for v in batch.values())

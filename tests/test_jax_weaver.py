@@ -102,23 +102,22 @@ def _tree_lanes(ct, interner, capacity):
     return na, (hi, lo), (chi, clo)
 
 
-def test_batched_merge_kernel_parity():
-    """The fully-on-device union kernel agrees with pure pairwise merge."""
-    rng = random.Random(2024)
-    B = 4
+def build_batch(rng, B, cap, n_edits=5, seed_word="ab"):
+    """B divergent replica pairs sharing one base, as stacked lanes.
+    Returns (pairs, lanes, metas) — the common input builder for the
+    batched-kernel and sharded-mesh tests."""
     pairs = []
     sites = set()
     for _ in range(B):
-        base = c.clist(*"ab")
+        base = c.clist(*seed_word)
         a = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
         bb = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
-        for _ in range(5):
+        for _ in range(n_edits):
             a = a.insert(rand_node(rng, a, site_id=a.ct.site_id))
             bb = bb.insert(rand_node(rng, bb, site_id=bb.ct.site_id))
         pairs.append((a.ct, bb.ct))
         sites |= {i[1] for i in a.ct.nodes} | {i[1] for i in bb.ct.nodes}
     interner = SiteInterner(sites)
-    cap = 32
     lanes = {k: [] for k in ("hi", "lo", "chi", "clo", "vc", "valid")}
     metas = []
     for a_ct, b_ct in pairs:
@@ -131,7 +130,15 @@ def test_batched_merge_kernel_parity():
         lanes["vc"].append(np.concatenate([na.vclass, nb.vclass]))
         lanes["valid"].append(np.concatenate([na.valid, nb.valid]))
         metas.append((na, nb))
-    stack = {k: np.stack(v) for k, v in lanes.items()}
+    return pairs, {k: np.stack(v) for k, v in lanes.items()}, metas
+
+
+def test_batched_merge_kernel_parity():
+    """The fully-on-device union kernel agrees with pure pairwise merge."""
+    rng = random.Random(2024)
+    B = 4
+    cap = 32
+    pairs, stack, metas = build_batch(rng, B, cap)
     order, rank, visible, conflict = jaxw.batched_merge_weave(
         stack["hi"], stack["lo"], stack["chi"], stack["clo"],
         stack["vc"], stack["valid"],
@@ -142,9 +149,7 @@ def test_batched_merge_kernel_parity():
         na, nb = metas[bidx]
         all_nodes = na.nodes + [None] * (cap - na.n) + nb.nodes + [None] * (cap - nb.n)
         lane_nodes = [all_nodes[i] for i in order[bidx]]
-        m = sum(1 for r in rank[bidx] if r < 2 * cap)
         # device weave: sorted lanes ordered by rank, masked lanes dropped
-        woven = [None] * (2 * cap)
         vis_sorted = visible[bidx]
         out, vis_nodes = {}, []
         for lane, r in enumerate(rank[bidx]):

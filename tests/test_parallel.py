@@ -15,40 +15,12 @@ from cause_tpu.ids import new_site_id
 from cause_tpu.parallel import make_mesh, sharded_merge_weave
 from cause_tpu.weaver.arrays import NodeArrays, SiteInterner
 
-from test_list import rand_node
-from test_jax_weaver import _tree_lanes
+from test_jax_weaver import _tree_lanes, build_batch
 
 
 def _require_multi_device():
     if len(jax.devices()) < 2:
         pytest.skip("needs the forced multi-device CPU platform")
-
-
-def _build_batch(rng, B, cap):
-    """B divergent replica pairs sharing one base, as stacked lanes."""
-    pairs = []
-    sites = set()
-    for _ in range(B):
-        base = c.clist(*"ab")
-        a = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
-        bb = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
-        for _ in range(4):
-            a = a.insert(rand_node(rng, a, site_id=a.ct.site_id))
-            bb = bb.insert(rand_node(rng, bb, site_id=bb.ct.site_id))
-        pairs.append((a.ct, bb.ct))
-        sites |= {i[1] for i in a.ct.nodes} | {i[1] for i in bb.ct.nodes}
-    interner = SiteInterner(sites)
-    lanes = {k: [] for k in ("hi", "lo", "chi", "clo", "vc", "valid")}
-    for a_ct, b_ct in pairs:
-        na, (ahi, alo), (achi, aclo) = _tree_lanes(a_ct, interner, cap)
-        nb, (bhi, blo), (bchi, bclo) = _tree_lanes(b_ct, interner, cap)
-        lanes["hi"].append(np.concatenate([ahi, bhi]))
-        lanes["lo"].append(np.concatenate([alo, blo]))
-        lanes["chi"].append(np.concatenate([achi, bchi]))
-        lanes["clo"].append(np.concatenate([aclo, bclo]))
-        lanes["vc"].append(np.concatenate([na.vclass, nb.vclass]))
-        lanes["valid"].append(np.concatenate([na.valid, nb.valid]))
-    return pairs, {k: np.stack(v) for k, v in lanes.items()}
 
 
 def test_mesh_has_8_virtual_devices():
@@ -62,7 +34,7 @@ def test_sharded_merge_matches_pure():
     B = n_dev * 2
     cap = 16
     mesh = make_mesh()
-    pairs, lanes = _build_batch(rng, B, cap)
+    pairs, lanes, _metas = build_batch(rng, B, cap, n_edits=4)
     order, rank, visible, digest, total_visible, n_conflicts = (
         sharded_merge_weave(
             mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
@@ -77,7 +49,6 @@ def test_sharded_merge_matches_pure():
         expect_visible = c_list.causal_list_to_list(pure)
         expect_total += len(expect_visible)
         # reconstruct device weave for this replica
-        na_nodes = sorted(a_ct.nodes)
         all_nodes = (
             [(nid,) + tuple(a_ct.nodes[nid]) for nid in sorted(a_ct.nodes)]
             + [None] * (cap - len(a_ct.nodes))
@@ -121,5 +92,45 @@ def test_digests_detect_convergence():
         mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
         lanes["vc"], lanes["valid"],
     )
+    digest = np.asarray(digest)
+    assert (digest == digest[0]).all()
+
+
+def test_digest_invariant_to_input_overlap():
+    """Replicas that converge to the same weave get the same digest even
+    when their inputs carried different duplicate overlap (row 1 merges
+    (A, B); row 2 merges (A-union-B, B) — same union, different lanes)."""
+    _require_multi_device()
+    cap = 32
+    mesh = make_mesh()
+    base = c.clist(*"xyz")
+    a = c_list.CausalList(base.ct.evolve(site_id=new_site_id())).conj("!")
+    bb = c_list.CausalList(base.ct.evolve(site_id=new_site_id())).cons("?")
+    union = s.merge_trees(c_list.weave, a.ct, bb.ct)
+    sites = {i[1] for i in union.nodes}
+    interner = SiteInterner(sites)
+    rows = []
+    for left_ct in (a.ct, union):
+        nl, (lhi, llo), (lchi, lclo) = _tree_lanes(left_ct, interner, cap)
+        nr, (rhi, rlo), (rchi, rclo) = _tree_lanes(bb.ct, interner, cap)
+        rows.append({
+            "hi": np.concatenate([lhi, rhi]),
+            "lo": np.concatenate([llo, rlo]),
+            "chi": np.concatenate([lchi, rchi]),
+            "clo": np.concatenate([lclo, rclo]),
+            "vc": np.concatenate([nl.vclass, nr.vclass]),
+            "valid": np.concatenate([nl.valid, nr.valid]),
+        })
+    B = len(jax.devices())
+    # rows 0..B/2-1 use overlap variant 0, the rest variant 1
+    lanes = {
+        k: np.stack([rows[0][k]] * (B // 2) + [rows[1][k]] * (B - B // 2))
+        for k in rows[0]
+    }
+    *_, digest, _total, n_conflicts = sharded_merge_weave(
+        mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
+        lanes["vc"], lanes["valid"],
+    )
+    assert int(n_conflicts) == 0
     digest = np.asarray(digest)
     assert (digest == digest[0]).all()
