@@ -50,7 +50,7 @@ def _merge_wave_scalar(hi, lo, chi, clo, vc, valid):
 
 
 def main() -> None:
-    smoke = bool(int(os.environ.get("BENCH_SMOKE", "0")))
+    smoke = os.environ.get("BENCH_SMOKE", "").strip() in ("1", "true", "yes")
     if smoke:
         B, n_base, n_div, cap, reps = 8, 800, 100, 1024, 3
     else:

@@ -137,6 +137,10 @@ def get_nodes(causal):
 # Causal conversion (core.cljc:53).
 from .collections.shared import causal_to_edn  # noqa: E402
 
+# Serialization: tagged JSON round-trip + bag-of-nodes reconstitution
+# (the reference's print/reader + refresh-caches checkpoint story).
+from .serde import dumps, loads  # noqa: E402
+
 __all__ = [
     "CausalBase",
     "CausalError",
@@ -179,6 +183,8 @@ __all__ = [
     "get_weave",
     "get_nodes",
     "causal_to_edn",
+    "dumps",
+    "loads",
     "is_special",
     "new_uid",
     "new_site_id",

@@ -23,7 +23,7 @@ same contract ``SiteInterner`` enforces for real trees.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Dict
 
 import numpy as np
 
@@ -32,7 +32,6 @@ from .weaver.arrays import (
     I32_MAX,
     PackSpec,
     VCLASS_HIDE,
-    VCLASS_NORMAL,
 )
 
 __all__ = ["chain_tree_lanes", "divergent_pair_lanes", "batched_pair_lanes"]

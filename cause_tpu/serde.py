@@ -1,0 +1,215 @@
+"""Serialization: tagged round-trip of causal collections and bases.
+
+The reference checkpoints through data, not files: printed tagged
+literals ``#causal/list`` / ``#causal/map`` / ``#causal/base`` round-trip
+through the reader (reference: src/causal/collections/list.cljc:137-147,
+map.cljc:218-228, base/core.cljc:424-432), and at rest only the
+``nodes`` bag needs storing — caches are reconstituted with
+``refresh-caches`` (shared.cljc:259-266, README.md:19).
+
+cause_tpu keeps both properties with a JSON encoding:
+
+- ``dumps``/``loads`` round-trip any CausalList / CausalMap /
+  CausalBase (and plain EDN-ish values) through tagged JSON;
+- only ``nodes`` is serialized per tree — ``loads`` rebuilds yarns and
+  the weave with the tree's weave function, so a decoded tree is also a
+  *proof* of cache idempotency;
+- everything is plain text: ship it over any transport and the merge
+  converges (the CRDT transport story, README.md:5).
+
+Tag scheme (single-``~``-key JSON objects; plain scalars pass through):
+
+====================  =========================================
+``{"~k": name}``      Keyword
+``{"~s": name}``      Special (``hide`` / ``h.hide`` / ``h.show``)
+``{"~r": uuid}``      Ref to a nested collection
+``{"~t": [...]}``     tuple
+``{"~set": [...]}``   set; ``{"~fset": [...]}`` frozenset
+``{"~d": [[k,v]..]}`` dict (keys can be any encodable value)
+``{"~causal": ...}``  CausalList / CausalMap / CausalBase
+====================  =========================================
+
+Node ids and id-valued causes are stored as plain ``[ts, site, tx]``
+arrays: positionally unambiguous (map keys are hashable, so a raw
+Python list can never be a key) and half the bytes of a tagged form.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from .cbase import CB, CausalBase, Ref
+from .collections import clist as c_list
+from .collections import cmap as c_map
+from .collections import shared as s
+from .collections.clist import CausalList
+from .collections.cmap import CausalMap
+from .collections.shared import CausalTree
+from .ids import Keyword, Special, is_id
+
+__all__ = ["to_data", "from_data", "dumps", "loads"]
+
+
+def _encode_id(nid) -> list:
+    return [nid[0], nid[1], nid[2]]
+
+
+def _encode_cause(cause):
+    """A cause is an id (lists) or a key (maps). Ids go positional."""
+    if is_id(cause):
+        return _encode_id(cause)
+    return to_data(cause)
+
+
+def _decode_cause(d):
+    if type(d) is list and len(d) == 3 and type(d[1]) is str:
+        return (d[0], d[1], d[2])
+    return from_data(d)
+
+
+def _encode_tree(ct: CausalTree) -> dict:
+    nodes = [
+        [_encode_id(nid), _encode_cause(cause), to_data(value)]
+        for nid, (cause, value) in sorted(ct.nodes.items())
+    ]
+    return {
+        "~causal": ct.type,
+        "uuid": ct.uuid,
+        "site_id": ct.site_id,
+        "lamport_ts": ct.lamport_ts,
+        "weaver": ct.weaver,
+        "nodes": nodes,
+    }
+
+
+def _decode_tree(d: dict) -> CausalTree:
+    """Reconstitute a tree from its bag of nodes: rebuild yarns, ts and
+    the weave from scratch (refresh-caches parity, shared.cljc:259-266),
+    then restore the recorded clock (it may run ahead of the max node
+    ts, e.g. after tombstone-only activity elsewhere in a base)."""
+    kind = d["~causal"]
+    nodes = {}
+    for enc_id, enc_cause, enc_value in d["nodes"]:
+        nid = (enc_id[0], enc_id[1], enc_id[2])
+        nodes[nid] = (_decode_cause(enc_cause), from_data(enc_value))
+    if kind == s.LIST_TYPE:
+        fresh, weave_fn = c_list.new_causal_tree(d["weaver"]), c_list.weave
+    elif kind == s.MAP_TYPE:
+        fresh, weave_fn = c_map.new_causal_tree(d["weaver"]), c_map.weave
+    else:
+        raise s.CausalError("unknown causal tag", {"tag": kind})
+    nodes.update(fresh.nodes)  # the seeded root sentinel (list trees)
+    ct = fresh.evolve(uuid=d["uuid"], site_id=d["site_id"], nodes=nodes)
+    ct = s.refresh_caches(weave_fn, ct)
+    return ct.evolve(lamport_ts=max(ct.lamport_ts, d["lamport_ts"]))
+
+
+def _encode_base(cb: CB) -> dict:
+    return {
+        "~causal": "base",
+        "uuid": cb.uuid,
+        "site_id": cb.site_id,
+        "lamport_ts": cb.lamport_ts,
+        "weaver": cb.weaver,
+        "root_uuid": cb.root_uuid,
+        "first_undo_lamport_ts": cb.first_undo_lamport_ts,
+        "last_undo_lamport_ts": cb.last_undo_lamport_ts,
+        "last_redo_lamport_ts": cb.last_redo_lamport_ts,
+        "history": [[_encode_id(nid), uuid] for nid, uuid in cb.history],
+        "collections": [to_data(c) for c in cb.collections.values()],
+    }
+
+
+def _decode_base(d: dict) -> CausalBase:
+    collections = {}
+    for enc in d["collections"]:
+        coll = from_data(enc)
+        collections[coll.get_uuid()] = coll
+    cb = CB(
+        lamport_ts=d["lamport_ts"],
+        uuid=d["uuid"],
+        site_id=d["site_id"],
+        history=[((e[0][0], e[0][1], e[0][2]), e[1]) for e in d["history"]],
+        first_undo_lamport_ts=d["first_undo_lamport_ts"],
+        last_undo_lamport_ts=d["last_undo_lamport_ts"],
+        last_redo_lamport_ts=d["last_redo_lamport_ts"],
+        root_uuid=d["root_uuid"],
+        collections=collections,
+        weaver=d["weaver"],
+    )
+    return CausalBase(cb)
+
+
+def to_data(x) -> Any:
+    """Encode a value (causal or plain) to JSON-able tagged data."""
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if isinstance(x, Keyword):
+        return {"~k": x.name}
+    if isinstance(x, Special):
+        return {"~s": x.name}
+    if isinstance(x, Ref):
+        return {"~r": x.uuid}
+    if isinstance(x, CausalList) or isinstance(x, CausalMap):
+        return _encode_tree(x.ct)
+    if isinstance(x, CausalTree):
+        return _encode_tree(x)
+    if isinstance(x, CausalBase):
+        return _encode_base(x.cb)
+    if isinstance(x, CB):
+        return _encode_base(x)
+    if isinstance(x, tuple):
+        return {"~t": [to_data(v) for v in x]}
+    if isinstance(x, frozenset):
+        return {"~fset": sorted((to_data(v) for v in x), key=repr)}
+    if isinstance(x, set):
+        return {"~set": sorted((to_data(v) for v in x), key=repr)}
+    if isinstance(x, dict):
+        return {"~d": [[to_data(k), to_data(v)] for k, v in x.items()]}
+    if isinstance(x, list):
+        return [to_data(v) for v in x]
+    raise s.CausalError(
+        "value is not serializable", {"type": type(x).__name__}
+    )
+
+
+def from_data(d) -> Any:
+    """Decode tagged data produced by ``to_data``. Decoded trees come
+    back wrapped (CausalList / CausalMap), matching what the facade
+    hands out."""
+    if d is None or isinstance(d, (bool, int, float, str)):
+        return d
+    if isinstance(d, list):
+        return [from_data(v) for v in d]
+    if isinstance(d, dict):
+        if "~k" in d:
+            return Keyword(d["~k"])
+        if "~s" in d:
+            return Special(d["~s"])
+        if "~r" in d:
+            return Ref(d["~r"])
+        if "~t" in d:
+            return tuple(from_data(v) for v in d["~t"])
+        if "~set" in d:
+            return set(from_data(v) for v in d["~set"])
+        if "~fset" in d:
+            return frozenset(from_data(v) for v in d["~fset"])
+        if "~d" in d:
+            return {from_data(k): from_data(v) for k, v in d["~d"]}
+        if "~causal" in d:
+            if d["~causal"] == "base":
+                return _decode_base(d)
+            ct = _decode_tree(d)
+            return CausalList(ct) if ct.type == s.LIST_TYPE else CausalMap(ct)
+    raise s.CausalError("undecodable data", {"data": type(d).__name__})
+
+
+def dumps(x, indent: Optional[int] = None) -> str:
+    """Serialize a causal collection / base / plain value to JSON text."""
+    return json.dumps(to_data(x), indent=indent)
+
+
+def loads(text: str) -> Any:
+    """Deserialize ``dumps`` output back to live causal values."""
+    return from_data(json.loads(text))

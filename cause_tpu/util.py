@@ -12,6 +12,7 @@ __all__ = [
     "sorted_insertion_index",
     "insert_sorted",
     "binary_search",
+    "char_seq",
 ]
 
 
@@ -54,6 +55,43 @@ def insert_sorted(coll, val, next_vals=None, index=None):
     if next_vals:
         out.extend(next_vals)
     out.extend(coll[index:])
+    return out
+
+
+def char_seq(text: str):
+    """Split a string into user-perceived character units
+    (util.cljc:76-92).
+
+    The reference exists to keep UTF-16 surrogate pairs together on the
+    JVM/JS hosts; Python 3 strings are code-point sequences so astral
+    chars are whole by construction. We additionally keep combining
+    marks, ZWJ sequences and variation selectors glued to their base
+    character — the case the reference documents as known-broken
+    (util.cljc:94-97). Like the reference it is available but not wired
+    into the CausalBase flattener, which splits per code point.
+    """
+    import unicodedata
+
+    out = []
+    cluster = ""
+    join_next = False
+    for ch in text:
+        cp = ord(ch)
+        is_zwj = cp == 0x200D
+        is_extend = (
+            unicodedata.combining(ch) != 0
+            or 0xFE00 <= cp <= 0xFE0F      # variation selectors
+            or 0x1F3FB <= cp <= 0x1F3FF    # emoji skin-tone modifiers
+        )
+        if cluster and (join_next or is_zwj or is_extend):
+            cluster += ch
+        else:
+            if cluster:
+                out.append(cluster)
+            cluster = ch
+        join_next = is_zwj
+    if cluster:
+        out.append(cluster)
     return out
 
 

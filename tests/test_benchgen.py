@@ -43,22 +43,15 @@ def build_real_pair(n_base, n_div, hide_every=0):
 
 def kernel_weave(lanes, cap, a_ct, b_ct):
     """Decode merge_weave_kernel output back to a host node weave."""
+    from test_jax_weaver import decode_device_weave, pair_lane_nodes
+
     order, rank, visible, conflict = jaxw.merge_weave_kernel(
         *(lanes[k] for k in ("hi", "lo", "chi", "clo", "vc", "valid"))
     )
     order, rank = np.asarray(order), np.asarray(rank)
     assert not bool(conflict)
-    all_nodes = (
-        [(nid,) + tuple(a_ct.nodes[nid]) for nid in sorted(a_ct.nodes)]
-        + [None] * (cap - len(a_ct.nodes))
-        + [(nid,) + tuple(b_ct.nodes[nid]) for nid in sorted(b_ct.nodes)]
-        + [None] * (cap - len(b_ct.nodes))
-    )
-    out = {}
-    for lane, r in enumerate(rank):
-        if r < 2 * cap:
-            out[int(r)] = all_nodes[order[lane]]
-    return [out[r] for r in sorted(out)]
+    weave, _ = decode_device_weave(order, rank, pair_lane_nodes(a_ct, b_ct, cap))
+    return weave
 
 
 def check_config(n_base, n_div, hide_every, cap):

@@ -133,6 +133,34 @@ def build_batch(rng, B, cap, n_edits=5, seed_word="ab"):
     return pairs, {k: np.stack(v) for k, v in lanes.items()}, metas
 
 
+def pair_lane_nodes(a_ct, b_ct, cap):
+    """Host node triples laid out exactly as the concatenated pair lanes
+    (sorted-id order, padded to cap per tree; padding lanes are None)."""
+    return (
+        [(nid,) + tuple(a_ct.nodes[nid]) for nid in sorted(a_ct.nodes)]
+        + [None] * (cap - len(a_ct.nodes))
+        + [(nid,) + tuple(b_ct.nodes[nid]) for nid in sorted(b_ct.nodes)]
+        + [None] * (cap - len(b_ct.nodes))
+    )
+
+
+def decode_device_weave(order_row, rank_row, all_nodes, visible_row=None):
+    """Decode one replica's kernel output back to a host node weave (and
+    the visible nodes, when a visibility mask is given). The shared
+    decoder for every kernel-vs-pure parity test."""
+    m = len(all_nodes)
+    out, vis = {}, []
+    for lane, r in enumerate(rank_row):
+        if r < m:
+            n = all_nodes[order_row[lane]]
+            out[int(r)] = n
+            if visible_row is not None and visible_row[lane]:
+                vis.append((int(r), n))
+    weave = [out[r] for r in sorted(out)]
+    vis.sort()
+    return weave, [n for _, n in vis]
+
+
 def test_batched_merge_kernel_parity():
     """The fully-on-device union kernel agrees with pure pairwise merge."""
     rng = random.Random(2024)
@@ -146,21 +174,10 @@ def test_batched_merge_kernel_parity():
     order, rank, visible, conflict = map(np.asarray, (order, rank, visible, conflict))
     assert not conflict.any()
     for bidx, (a_ct, b_ct) in enumerate(pairs):
-        na, nb = metas[bidx]
-        all_nodes = na.nodes + [None] * (cap - na.n) + nb.nodes + [None] * (cap - nb.n)
-        lane_nodes = [all_nodes[i] for i in order[bidx]]
-        # device weave: sorted lanes ordered by rank, masked lanes dropped
-        vis_sorted = visible[bidx]
-        out, vis_nodes = {}, []
-        for lane, r in enumerate(rank[bidx]):
-            if r < 2 * cap and lane_nodes[lane] is not None:
-                out[int(r)] = lane_nodes[lane]
-                if vis_sorted[lane]:
-                    vis_nodes.append((int(r), lane_nodes[lane]))
-        device_weave = [out[r] for r in sorted(out)]
+        all_nodes = pair_lane_nodes(a_ct, b_ct, cap)
+        device_weave, vis_nodes = decode_device_weave(
+            order[bidx], rank[bidx], all_nodes, visible[bidx]
+        )
         pure_merged = s.merge_trees(c_list.weave, a_ct, b_ct)
         assert device_weave == pure_merged.weave, f"pair {bidx}"
-        # visibility parity
-        vis_nodes.sort()
-        expect_visible = c_list.causal_list_to_list(pure_merged)
-        assert [n for _, n in vis_nodes] == expect_visible, f"pair {bidx}"
+        assert vis_nodes == c_list.causal_list_to_list(pure_merged), f"pair {bidx}"

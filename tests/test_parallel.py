@@ -15,7 +15,12 @@ from cause_tpu.ids import new_site_id
 from cause_tpu.parallel import make_mesh, sharded_merge_weave
 from cause_tpu.weaver.arrays import NodeArrays, SiteInterner
 
-from test_jax_weaver import _tree_lanes, build_batch
+from test_jax_weaver import (
+    _tree_lanes,
+    build_batch,
+    decode_device_weave,
+    pair_lane_nodes,
+)
 
 
 def _require_multi_device():
@@ -49,17 +54,8 @@ def test_sharded_merge_matches_pure():
         expect_visible = c_list.causal_list_to_list(pure)
         expect_total += len(expect_visible)
         # reconstruct device weave for this replica
-        all_nodes = (
-            [(nid,) + tuple(a_ct.nodes[nid]) for nid in sorted(a_ct.nodes)]
-            + [None] * (cap - len(a_ct.nodes))
-            + [(nid,) + tuple(b_ct.nodes[nid]) for nid in sorted(b_ct.nodes)]
-            + [None] * (cap - len(b_ct.nodes))
-        )
-        out = {}
-        for lane, r in enumerate(rank[bidx]):
-            if r < 2 * cap:
-                out[int(r)] = all_nodes[order[bidx][lane]]
-        device_weave = [out[r] for r in sorted(out)]
+        all_nodes = pair_lane_nodes(a_ct, b_ct, cap)
+        device_weave, _ = decode_device_weave(order[bidx], rank[bidx], all_nodes)
         assert device_weave == pure.weave, f"replica {bidx}"
     assert int(total_visible) == expect_total
 

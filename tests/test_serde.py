@@ -1,0 +1,117 @@
+"""Serialization round-trips: dumps/loads must reconstitute identical
+live values from the bag of nodes alone (reference checkpoint story:
+tagged-literal round-trip + refresh-caches, list.cljc:137-147,
+shared.cljc:259-266)."""
+
+import random
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import K, serde
+from cause_tpu.collections import clist as c_list
+from cause_tpu.ids import new_site_id
+
+from test_list import rand_node
+
+
+def assert_tree_equal(a_ct, b_ct):
+    assert a_ct.type == b_ct.type
+    assert a_ct.uuid == b_ct.uuid
+    assert a_ct.site_id == b_ct.site_id
+    assert a_ct.lamport_ts == b_ct.lamport_ts
+    assert a_ct.weaver == b_ct.weaver
+    assert a_ct.nodes == b_ct.nodes
+    assert a_ct.yarns == b_ct.yarns
+    assert a_ct.weave == b_ct.weave
+
+
+def test_list_round_trip():
+    cl = c.clist(*"hello").conj("!", 42, None, True, 1.5)
+    cl = cl.append(list(cl)[0][0], c.hide)
+    out = serde.loads(serde.dumps(cl))
+    assert isinstance(out, c.CausalList)
+    assert_tree_equal(out.ct, cl.ct)
+    assert out.causal_to_edn() == cl.causal_to_edn()
+
+
+def test_list_round_trip_fuzz():
+    rng = random.Random(7)
+    sites = [new_site_id() for _ in range(4)]
+    cl = c.clist()
+    for _ in range(40):
+        cl = cl.insert(rand_node(rng, cl, site_id=rng.choice(sites)))
+    out = serde.loads(serde.dumps(cl))
+    assert_tree_equal(out.ct, cl.ct)
+
+
+def test_map_round_trip():
+    cm = c.cmap().append(K("a"), "x").append(K("a"), "y").append("plain", 7)
+    first_id = list(cm)[0][0]
+    cm = cm.append(first_id, c.hide)
+    out = serde.loads(serde.dumps(cm))
+    assert isinstance(out, c.CausalMap)
+    assert_tree_equal(out.ct, cm.ct)
+    assert out.causal_to_edn() == cm.causal_to_edn()
+
+
+def test_base_round_trip_with_nesting_and_undo():
+    cb = c.base()
+    cb = c.transact(cb, [[None, None, [K("div"), {K("title"): "hi"}, "ab"]]])
+    refs = [n[2] for n in c.get_collection(cb) if c.is_ref(n[2])]
+    cb = c.transact(cb, [[refs[0].uuid, None, {K("title"): "yo"}]])
+    cb = c.undo(cb)
+    out = serde.loads(serde.dumps(cb))
+    assert isinstance(out, c.CausalBase)
+    assert out.causal_to_edn() == cb.causal_to_edn()
+    assert out.cb.history == cb.cb.history
+    assert out.cb.lamport_ts == cb.cb.lamport_ts
+    assert out.cb.root_uuid == cb.cb.root_uuid
+    assert out.cb.first_undo_lamport_ts == cb.cb.first_undo_lamport_ts
+    assert out.cb.last_undo_lamport_ts == cb.cb.last_undo_lamport_ts
+    assert set(out.cb.collections) == set(cb.cb.collections)
+    for uuid in cb.cb.collections:
+        assert_tree_equal(out.cb.collections[uuid].ct,
+                          cb.cb.collections[uuid].ct)
+    # the decoded base keeps working: redo then new edits
+    out2 = c.redo(out)
+    assert c.redo(cb).causal_to_edn() == out2.causal_to_edn()
+
+
+def test_serialized_nodes_only():
+    """At-rest storage is the bag of nodes: no yarns/weave in the text
+    (README.md:19 — caches reconstituted on load)."""
+    cl = c.clist(*"xyz")
+    data = serde.to_data(cl)
+    assert set(data) == {"~causal", "uuid", "site_id", "lamport_ts",
+                        "weaver", "nodes"}
+
+
+def test_plain_value_round_trip():
+    v = {K("a"): [1, "two", (3, 4)], "s": {5, 6}, K("sp"): c.hide}
+    out = serde.loads(serde.dumps(v))
+    assert out == v
+
+
+def test_frozenset_round_trip():
+    v = frozenset({1, 2})
+    out = serde.loads(serde.dumps(v))
+    assert out == v and isinstance(out, frozenset)
+    keyed = {frozenset({"a"}): "x"}
+    assert serde.loads(serde.dumps(keyed)) == keyed
+
+
+def test_merge_after_round_trip():
+    """Serde is a transport: ship a replica as text, merge, converge."""
+    base = c.clist(*"seed")
+    a = c_list.CausalList(base.ct.evolve(site_id=new_site_id())).conj("A")
+    b = c_list.CausalList(base.ct.evolve(site_id=new_site_id())).conj("B")
+    b_shipped = serde.loads(serde.dumps(b))
+    m1 = a.merge(b_shipped)
+    m2 = b_shipped.merge(a)
+    assert m1.causal_to_edn() == m2.causal_to_edn()
+
+
+def test_unserializable_raises():
+    with pytest.raises(c.CausalError):
+        serde.dumps(object())

@@ -4,6 +4,17 @@ from cause_tpu import util as u
 from cause_tpu.ids import K, Keyword, Special, HIDE, H_HIDE, H_SHOW, is_id, is_special, node
 
 
+def test_char_seq():
+    assert u.char_seq("abc") == ["a", "b", "c"]
+    # astral plane chars stay whole (the reference's surrogate-pair case)
+    assert u.char_seq("a\U0001F600b") == ["a", "\U0001F600", "b"]
+    # combining marks and zwj sequences stay glued to their base
+    assert u.char_seq("éx") == ["é", "x"]
+    woman_fire = "\U0001F469‍\U0001F692"
+    assert u.char_seq("a" + woman_fire + "b") == ["a", woman_fire, "b"]
+    assert u.char_seq("") == []
+
+
 def test_sorted_insertion_index():
     assert u.sorted_insertion_index([], 5) == 0
     assert u.sorted_insertion_index([1, 3, 5], 4) == 2
