@@ -66,6 +66,10 @@ def weave(ct: CausalTree, node=None, more_consecutive_nodes_in_same_tx=None) -> 
             from ..weaver import jaxw
 
             return jaxw.refresh_list_weave(ct)
+        if ct.weaver == "native":
+            from ..weaver import nativew
+
+            return nativew.refresh_list_weave(ct)
         w = []
         for nid in sorted(ct.nodes):
             w = pure.weave_node(w, node_from_kv((nid, ct.nodes[nid])))
@@ -180,6 +184,10 @@ class CausalList:
             from ..weaver import jaxw
 
             return CausalList(jaxw.merge_list_trees(self.ct, other.ct))
+        if self.ct.weaver == "native":
+            from ..weaver import nativew
+
+            return CausalList(nativew.merge_trees(self.ct, other.ct))
         return CausalList(s.merge_trees(weave, self.ct, other.ct))
 
     # -- CausalTo (protocols.cljc:33-35) --

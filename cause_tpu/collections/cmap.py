@@ -63,6 +63,10 @@ def weave(ct: CausalTree, node=None, more_nodes=None) -> CausalTree:
     folds all nodes in sorted id order.
     """
     if node is None:
+        if ct.weaver == "native":
+            from ..weaver import nativew
+
+            return nativew.refresh_map_weave(ct)
         ct = ct.evolve(weave={})
         for nid in sorted(ct.nodes):
             ct = weave(ct, node_from_kv((nid, ct.nodes[nid])))
@@ -217,6 +221,10 @@ class CausalMap:
         )
 
     def merge(self, other: "CausalMap") -> "CausalMap":
+        if self.ct.weaver == "native":
+            from ..weaver import nativew
+
+            return CausalMap(nativew.merge_trees(self.ct, other.ct))
         return CausalMap(s.merge_trees(weave, self.ct, other.ct))
 
     # -- CausalTo --

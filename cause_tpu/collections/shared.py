@@ -50,6 +50,7 @@ __all__ = [
     "refresh_caches",
     "weft",
     "check_mergeable",
+    "union_nodes",
     "merge_trees",
     "causal_to_edn",
 ]
@@ -251,6 +252,31 @@ def check_mergeable(ct1: CausalTree, ct2: CausalTree) -> None:
             "Causal UUID missmatch. Merge not allowed.",
             {"causes": {"uuid-missmatch"}, "uuids": [ct1.uuid, ct2.uuid]},
         )
+
+
+def union_nodes(ct1: CausalTree, ct2: CausalTree) -> CausalTree:
+    """The host half of every accelerated merge: guard, union the node
+    stores (append-only conflict check, as in ``insert``), fast-forward
+    the lamport clock, and respin the yarns. The caller reweaves with
+    its backend. Shared by the jax and native merge paths."""
+    check_mergeable(ct1, ct2)
+    nodes = dict(ct1.nodes)
+    max_new_ts = ct1.lamport_ts
+    for nid, body in ct2.nodes.items():
+        existing = nodes.get(nid)
+        if existing is not None:
+            if existing != body:
+                raise CausalError(
+                    "This node is already in the tree and can't be changed.",
+                    {"causes": {"append-only", "edits-not-allowed"},
+                     "existing_node": (nid,) + existing},
+                )
+            continue
+        if nid[0] > max_new_ts:
+            max_new_ts = nid[0]
+        nodes[nid] = body
+    ct = ct1.evolve(nodes=nodes, lamport_ts=max_new_ts)
+    return spin(ct)
 
 
 def merge_trees(weave_fn: WeaveFn, ct1: CausalTree, ct2: CausalTree) -> CausalTree:

@@ -1,0 +1,108 @@
+"""Native host weaver: ctypes bindings over the C++ linearizer.
+
+The runtime around the TPU compute path is native where it is hot: full
+reweaves and merges on the host go through ``weaver.cpp``'s O(n)
+preorder construction instead of the O(n^2) sequential replay. The
+shared library is built lazily with g++ on first use and cached next to
+the source (keyed by source mtime); ``available()`` reports whether the
+toolchain produced one, and every caller falls back to the pure weaver
+when it did not.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["available", "weave_list_ranks", "weave_map_ranks", "lib"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "weaver.cpp")
+_SO = os.path.join(_HERE, "_ct_weaver.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    """Compile weaver.cpp to a shared library (cached by mtime)."""
+    if not (os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+    lib = ctypes.CDLL(_SO)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.ct_weave_list.restype = ctypes.c_int32
+    lib.ct_weave_list.argtypes = [ctypes.c_int32, i32p, i32p, i32p]
+    lib.ct_weave_map.restype = ctypes.c_int32
+    lib.ct_weave_map.argtypes = [ctypes.c_int32, ctypes.c_int32, i32p, i32p,
+                                 i32p, i32p, i32p]
+    return lib
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when the build failed."""
+    global _lib, _build_failed
+    if _lib is None and not _build_failed:
+        with _lock:
+            if _lib is None and not _build_failed:
+                try:
+                    _lib = _build()
+                except (OSError, subprocess.CalledProcessError):
+                    _build_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def _i32(a) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int32)
+
+
+def _ptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+def weave_list_ranks(cause_idx, vclass):
+    """Weave rank for one list tree's lanes (ascending id order, lane 0
+    = root). Raises RuntimeError when the library is missing or the
+    lanes are malformed."""
+    L = lib()
+    if L is None:
+        raise RuntimeError("native weaver unavailable")
+    cause_idx = _i32(cause_idx)
+    vclass = _i32(vclass)
+    n = cause_idx.shape[0]
+    rank = np.empty(n, np.int32)
+    rc = L.ct_weave_list(n, _ptr(cause_idx), _ptr(vclass), _ptr(rank))
+    if rc != 0:
+        raise RuntimeError(f"ct_weave_list failed with code {rc}")
+    return rank
+
+
+def weave_map_ranks(cause_idx, key_rank, vclass, n_keys: int):
+    """(rank, key_out) for one map tree's lanes: a forest preorder where
+    each key's lanes are contiguous in that key's weave order."""
+    L = lib()
+    if L is None:
+        raise RuntimeError("native weaver unavailable")
+    cause_idx = _i32(cause_idx)
+    key_rank = _i32(key_rank)
+    vclass = _i32(vclass)
+    n = cause_idx.shape[0]
+    rank = np.empty(n, np.int32)
+    key_out = np.empty(n, np.int32)
+    rc = L.ct_weave_map(
+        n, n_keys, _ptr(cause_idx), _ptr(key_rank), _ptr(vclass),
+        _ptr(rank), _ptr(key_out),
+    )
+    if rc != 0:
+        raise RuntimeError(f"ct_weave_map failed with code {rc}")
+    return rank, key_out

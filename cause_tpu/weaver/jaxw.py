@@ -51,7 +51,6 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..collections.shared import CausalError, check_mergeable
 from .arrays import (
     I32_MAX,
     NodeArrays,
@@ -203,31 +202,12 @@ def refresh_list_weave(ct):
 
 
 def merge_list_trees(ct1, ct2):
-    """Device-backed merge: union the node stores host-side (dict merge
-    with the reference's append-only conflict check), then one batched
-    reweave on device — O((n+m) log) instead of the reference's O(n*m)
-    reduce-insert, with an identical resulting tree."""
-    check_mergeable(ct1, ct2)
-    nodes = dict(ct1.nodes)
-    max_new_ts = ct1.lamport_ts
-    for nid, body in ct2.nodes.items():
-        existing = nodes.get(nid)
-        if existing is not None:
-            if existing != body:
-                raise CausalError(
-                    "This node is already in the tree and can't be changed.",
-                    {"causes": {"append-only", "edits-not-allowed"},
-                     "existing_node": (nid,) + existing},
-                )
-            continue
-        if nid[0] > max_new_ts:
-            max_new_ts = nid[0]
-        nodes[nid] = body
+    """Device-backed merge: union the node stores host-side, then one
+    batched reweave on device — O((n+m) log) instead of the reference's
+    O(n*m) reduce-insert, with an identical resulting tree."""
     from ..collections import shared as s
 
-    ct = ct1.evolve(nodes=nodes, lamport_ts=max_new_ts)
-    ct = s.spin(ct)
-    return refresh_list_weave(ct)
+    return refresh_list_weave(s.union_nodes(ct1, ct2))
 
 
 # ------------------------- batched merge kernel -------------------------

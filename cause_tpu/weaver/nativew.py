@@ -1,0 +1,158 @@
+"""The native host weave backend ("native"): full reweaves and merges
+through the C++ linearizer (cause_tpu/native/weaver.cpp).
+
+Same contract as the device weaver — the pure sequential weaver is the
+oracle; this backend recomputes whole weaves in O(n) instead of the
+O(n^2) host replay (reference: src/causal/collections/list.cljc:20-28)
+and turns merges into union + one reweave instead of the O(n*m)
+reduce-insert (shared.cljc:300-314). Incremental single-node weaves
+stay on the pure path, where the O(n) scan is already optimal.
+
+Fallback discipline: any input outside the native domain (a weft-cut
+"gibberish tree" with dangling causes, a map whose id-caused nodes
+target other id-caused nodes — semantics the pure weaver defines by
+its insertion scan, not by tree structure) silently falls back to the
+pure full rebuild, so ``weaver="native"`` never changes semantics, only
+speed.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+from .. import native
+from ..ids import ROOT_ID, ROOT_NODE, is_id, node_from_kv
+
+__all__ = [
+    "available",
+    "refresh_list_weave",
+    "refresh_map_weave",
+    "merge_trees",
+]
+
+
+def available() -> bool:
+    return native.available()
+
+
+def _list_lanes(nodes_map) -> Tuple[list, np.ndarray, np.ndarray]:
+    """(sorted_nodes, cause_idx, vclass) for a list tree. Lane order is
+    sorted id order; lane 0 is the root sentinel."""
+    from .arrays import vclass_of
+
+    ids = sorted(nodes_map)
+    idx_of = {nid: i for i, nid in enumerate(ids)}
+    n = len(ids)
+    cause_idx = np.full(n, -1, np.int32)
+    vclass = np.zeros(n, np.int32)
+    nodes = []
+    for i, nid in enumerate(ids):
+        cause, value = nodes_map[nid]
+        if i > 0:
+            ci = idx_of.get(cause, -1)
+            if ci < 0:
+                raise _OutsideDomain()  # dangling cause (weft gibberish)
+            cause_idx[i] = ci
+        vclass[i] = vclass_of(value)
+        nodes.append((nid, cause, value))
+    return nodes, cause_idx, vclass
+
+
+class _OutsideDomain(Exception):
+    pass
+
+
+def refresh_list_weave(ct):
+    """Full list-weave rebuild through the native linearizer; identical
+    output to the pure replay (falls back to it off-domain)."""
+    from ..collections import clist as c_list
+
+    try:
+        nodes, cause_idx, vclass = _list_lanes(ct.nodes)
+        rank = native.weave_list_ranks(cause_idx, vclass)
+    except (RuntimeError, _OutsideDomain):
+        return c_list.weave(ct.evolve(weaver="pure")).evolve(weaver=ct.weaver)
+    order = np.argsort(rank, kind="stable")
+    return ct.evolve(weave=[nodes[i] for i in order])
+
+
+def _map_lanes(nodes_map):
+    """(sorted_nodes, cause_idx, key_rank, vclass, keys) for a map tree.
+
+    Key resolution follows the pure weaver exactly (single level:
+    an id-caused node's key is its target's cause, map.cljc:31-37), so
+    the native domain requires id-caused nodes to target key-caused
+    nodes — everything the collection/base APIs generate.
+    """
+    from .arrays import vclass_of
+
+    ids = sorted(nodes_map)
+    idx_of = {nid: i for i, nid in enumerate(ids)}
+    n = len(ids)
+    cause_idx = np.full(n, -1, np.int32)
+    key_rank = np.full(n, -1, np.int32)
+    vclass = np.zeros(n, np.int32)
+    keys: List = []
+    key_ordinal: Dict = {}
+    nodes = []
+    for i, nid in enumerate(ids):
+        cause, value = nodes_map[nid]
+        vclass[i] = vclass_of(value)
+        if is_id(cause):
+            ci = idx_of.get(tuple(cause), -1)
+            if ci < 0:
+                raise _OutsideDomain()  # dangling target
+            target_cause = nodes_map[tuple(cause)][0]
+            if is_id(target_cause):
+                raise _OutsideDomain()  # id-caused targeting id-caused
+            cause_idx[i] = ci
+        else:
+            k = cause
+            if k not in key_ordinal:
+                key_ordinal[k] = len(keys)
+                keys.append(k)
+            key_rank[i] = key_ordinal[k]
+        nodes.append((nid, cause, value))
+    return nodes, cause_idx, key_rank, vclass, keys
+
+
+def refresh_map_weave(ct):
+    """Full map-weave rebuild through the native linearizer: one forest
+    preorder, split into the per-key weave dict (identical to the pure
+    per-key replay; falls back off-domain)."""
+    from ..collections import cmap as c_map
+
+    try:
+        nodes, cause_idx, key_rank, vclass, keys = _map_lanes(ct.nodes)
+        rank, key_out = native.weave_map_ranks(
+            cause_idx, key_rank, vclass, len(keys)
+        )
+    except (RuntimeError, _OutsideDomain):
+        return c_map.weave(ct.evolve(weaver="pure")).evolve(weaver=ct.weaver)
+    order = np.argsort(rank, kind="stable")
+    weave: Dict = {}
+    for i in order:
+        nid, cause, value = nodes[i]
+        k = keys[key_out[i]]
+        in_weave_cause = cause if is_id(cause) else ROOT_ID
+        weave.setdefault(k, [ROOT_NODE]).append((nid, in_weave_cause, value))
+    return ct.evolve(weave=weave)
+
+
+def refresh_weave(ct):
+    from ..collections import shared as s
+
+    if ct.type == s.LIST_TYPE:
+        return refresh_list_weave(ct)
+    return refresh_map_weave(ct)
+
+
+def merge_trees(ct1, ct2):
+    """Union the node stores host-side, then one native reweave —
+    O(n+m) instead of the reference's O(n*m) reduce-insert, with an
+    identical resulting tree."""
+    from ..collections import shared as s
+
+    return refresh_weave(s.union_nodes(ct1, ct2))
