@@ -1,0 +1,260 @@
+"""The benchmark suite: the five BASELINE configs as a CLI.
+
+The reference keeps criterium harnesses in REPL comment blocks and
+publishes no numbers (reference: test/causal/collections/
+list_test.cljc:219-228); the roadmap wants a benchmark/estimator CLI
+(README.md:242). cause_tpu ships one: every BASELINE.json config is a
+named, runnable benchmark with a JSON-line report, across weave
+backends where that makes sense.
+
+    python -m cause_tpu.benchmarks                  # all, default sizes
+    python -m cause_tpu.benchmarks -c 1 -w native   # one config/backend
+    python -m cause_tpu.benchmarks --profile DIR    # jax.profiler trace
+                                                    # around device runs
+
+Configs (BASELINE.json "configs"):
+  1 CausalList append-only weave (single site, 1k char insertions)
+  2 CausalList 3-site concurrent insert + hide tombstones
+  3 CausalMap key overwrite + id-caused undo/redo tombstones
+  4 CausalBase nested list-in-map rich-text doc
+  5 batched merge of divergent CausalLists (the north-star; device)
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import time
+from typing import Callable, Dict, Optional
+
+from . import benchgen
+from .cbase import new_causal_base
+from .collections.clist import CausalList, new_causal_list
+from .collections.cmap import new_causal_map
+from .ids import K, new_site_id
+
+__all__ = ["CONFIGS", "run_config", "main"]
+
+
+def _timed(fn: Callable, reps: int = 3):
+    """Best-of-reps wall time (seconds) and the last result."""
+    best, out = float("inf"), None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, out
+
+
+def config1_append_only(weaver: str, n: int = 1000, reps: int = 3) -> dict:
+    """Single-site append-only list: n chars conj'd one at a time (the
+    typing hot path, reference list.cljc:36-40)."""
+    text = ("abcdefgh" * (n // 8 + 1))[:n]
+
+    def run():
+        cl = new_causal_list(weaver=weaver)
+        for ch in text:
+            cl = cl.conj(ch)
+        return cl
+
+    secs, cl = _timed(run, reps)
+    assert len(cl) == n
+    return {
+        "config": 1,
+        "metric": f"append-only conj x{n}",
+        "weaver": weaver,
+        "value": round(n / secs, 1),
+        "unit": "nodes/sec",
+    }
+
+
+def config2_concurrent_hide(weaver: str, n_per_site: int = 120,
+                            reps: int = 3) -> dict:
+    """3 sites interleave inserts, hide every 5th node, then all three
+    pairwise merges converge."""
+    import random
+
+    def run():
+        rng = random.Random(5)
+        base = new_causal_list(*"seed", weaver=weaver)
+        sites = [new_site_id() for _ in range(3)]
+        replicas = [
+            CausalList(base.ct.evolve(site_id=site)) for site in sites
+        ]
+        for step in range(n_per_site):
+            for i, r in enumerate(replicas):
+                nodes = list(r.ct.weave)
+                cause = rng.choice(nodes)[0]
+                ts = r.get_ts() + 1
+                nid = (ts, sites[i], 0)
+                if step % 5 == 4:
+                    from .ids import HIDE
+
+                    r = r.insert((nid, cause, HIDE))
+                else:
+                    r = r.insert((nid, cause, f"v{step}"))
+                replicas[i] = r
+        m = replicas[0].merge(replicas[1]).merge(replicas[2])
+        return m
+
+    secs, m = _timed(run, reps)
+    total_nodes = len(m.ct.nodes)
+    return {
+        "config": 2,
+        "metric": f"3-site concurrent insert+hide, {total_nodes} nodes",
+        "weaver": weaver,
+        "value": round(total_nodes / secs, 1),
+        "unit": "nodes/sec",
+    }
+
+
+def config3_map_undo_redo(weaver: str, n_keys: int = 40,
+                          overwrites: int = 6, reps: int = 3) -> dict:
+    """Map LWW overwrites plus id-caused h.hide/h.show tombstone churn
+    (the map undo-by-id shape, reference map.cljc:283-288)."""
+    from .ids import H_HIDE, H_SHOW
+
+    def run():
+        cm = new_causal_map(weaver=weaver)
+        for k in range(n_keys):
+            key = K(f"k{k}")
+            for o in range(overwrites):
+                cm = cm.assoc(key, f"v{o}")
+        # undo/redo the latest overwrite of each key by id
+        for node in list(cm):
+            nid = node[0]
+            cm = cm.append(nid, H_HIDE)
+            cm = cm.append(nid, H_SHOW)
+        return cm
+
+    secs, cm = _timed(run, reps)
+    total = len(cm.ct.nodes)
+    return {
+        "config": 3,
+        "metric": f"map overwrite+undo/redo, {total} nodes",
+        "weaver": weaver,
+        "value": round(total / secs, 1),
+        "unit": "nodes/sec",
+    }
+
+
+def config4_rich_text_base(weaver: str, paragraphs: int = 8,
+                           para_len: int = 60, reps: int = 3) -> dict:
+    """CausalBase rich-text doc: a map of paragraph-lists of chars, with
+    transactions, edits, and undo/redo (the slate-eunoia shape)."""
+
+    from .cbase import is_ref
+
+    def run():
+        cb = new_causal_base(weaver=weaver)
+        # map of paragraphs; each paragraph is a nested char-list
+        doc = {K(f"p{i}"): ["x" * para_len] for i in range(paragraphs)}
+        cb = cb.transact([[None, None, doc]])
+        root = cb.get_collection()
+        # edit every paragraph (one tx each), then undo/redo the last
+        for node in list(root):
+            if is_ref(node[2]):
+                cb = cb.transact([[node[2].uuid, None, "!"]])
+        cb = cb.undo()
+        cb = cb.redo()
+        return cb
+
+    secs, cb = _timed(run, reps)
+    total = sum(len(coll.ct.nodes) for coll in cb.cb.collections.values())
+    return {
+        "config": 4,
+        "metric": f"base rich-text doc, {total} nodes",
+        "weaver": weaver,
+        "value": round(total / secs, 1),
+        "unit": "nodes/sec",
+    }
+
+
+def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
+                          n_base: int = 800, n_div: int = 100,
+                          cap: int = 1024, reps: int = 3,
+                          profile_dir: Optional[str] = None) -> dict:
+    """Batched device merge of divergent replicas (north-star shape;
+    sizes here are CLI defaults — bench.py runs the full 1024x10k)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .weaver.jaxw import merge_weave_kernel
+
+    @jax.jit
+    def scalar_out(*a):
+        order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(*a)
+        return (
+            jnp.sum(rank.astype(jnp.float32))
+            + jnp.sum(order.astype(jnp.float32))
+            + jnp.sum(visible.astype(jnp.float32))
+            + jnp.sum(conflict.astype(jnp.float32))
+        )
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=n_replicas, n_base=n_base, n_div=n_div,
+        capacity=cap, hide_every=8,
+    )
+    args = [jax.device_put(batch[k])
+            for k in ("hi", "lo", "chi", "clo", "vc", "valid")]
+    float(scalar_out(*args))  # compile + warm
+
+    ctx = (
+        jax.profiler.trace(profile_dir)
+        if profile_dir
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        secs, _ = _timed(lambda: float(scalar_out(*args)), reps)
+    return {
+        "config": 5,
+        "metric": f"batched merge, {n_replicas} pairs x "
+                  f"{1 + n_base + n_div}-node lists",
+        "weaver": "jax",
+        "value": round(secs * 1000.0, 3),
+        "unit": "ms",
+    }
+
+
+CONFIGS: Dict[int, Callable] = {
+    1: config1_append_only,
+    2: config2_concurrent_hide,
+    3: config3_map_undo_redo,
+    4: config4_rich_text_base,
+    5: config5_batched_merge,
+}
+
+# configs 1-4 exercise the host path; 5 is device-only
+HOST_WEAVERS = ("pure", "native")
+
+
+def run_config(num: int, weaver: str, profile_dir: Optional[str] = None) -> dict:
+    fn = CONFIGS[num]
+    if num == 5:
+        return fn(profile_dir=profile_dir)
+    return fn(weaver)
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-c", "--config", type=int, choices=sorted(CONFIGS),
+                   help="run one config (default: all)")
+    p.add_argument("-w", "--weaver", default=None,
+                   help="weave backend for host configs (pure|native)")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="write a jax.profiler trace for device configs")
+    args = p.parse_args(argv)
+
+    nums = [args.config] if args.config else sorted(CONFIGS)
+    for num in nums:
+        if num == 5:
+            print(json.dumps(run_config(num, "jax", args.profile)))
+            continue
+        weavers = [args.weaver] if args.weaver else list(HOST_WEAVERS)
+        for w in weavers:
+            print(json.dumps(run_config(num, w)))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,10 +31,18 @@ _build_failed = False
 
 
 def _build() -> Optional[ctypes.CDLL]:
-    """Compile weaver.cpp to a shared library (cached by mtime)."""
+    """Compile weaver.cpp to a shared library (cached by mtime). The
+    compile goes to a per-pid temp file and is renamed into place so
+    concurrent first-use across processes never loads a torn .so."""
     if not (os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", _SO, _SRC]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        tmp = f"{_SO}.{os.getpid()}.tmp"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+            os.replace(tmp, _SO)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     lib = ctypes.CDLL(_SO)
     i32p = ctypes.POINTER(ctypes.c_int32)
     lib.ct_weave_list.restype = ctypes.c_int32

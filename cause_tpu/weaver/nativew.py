@@ -23,7 +23,7 @@ from typing import Dict, List, Tuple
 import numpy as np
 
 from .. import native
-from ..ids import ROOT_ID, ROOT_NODE, is_id, node_from_kv
+from ..ids import ROOT_ID, ROOT_NODE, is_id
 
 __all__ = [
     "available",
@@ -37,31 +37,28 @@ def available() -> bool:
     return native.available()
 
 
-def _list_lanes(nodes_map) -> Tuple[list, np.ndarray, np.ndarray]:
-    """(sorted_nodes, cause_idx, vclass) for a list tree. Lane order is
-    sorted id order; lane 0 is the root sentinel."""
-    from .arrays import vclass_of
-
-    ids = sorted(nodes_map)
-    idx_of = {nid: i for i, nid in enumerate(ids)}
-    n = len(ids)
-    cause_idx = np.full(n, -1, np.int32)
-    vclass = np.zeros(n, np.int32)
-    nodes = []
-    for i, nid in enumerate(ids):
-        cause, value = nodes_map[nid]
-        if i > 0:
-            ci = idx_of.get(cause, -1)
-            if ci < 0:
-                raise _OutsideDomain()  # dangling cause (weft gibberish)
-            cause_idx[i] = ci
-        vclass[i] = vclass_of(value)
-        nodes.append((nid, cause, value))
-    return nodes, cause_idx, vclass
-
-
 class _OutsideDomain(Exception):
     pass
+
+
+def _list_lanes(nodes_map) -> Tuple[list, np.ndarray, np.ndarray]:
+    """(sorted_nodes, cause_idx, vclass) for a list tree, via the shared
+    NodeArrays marshaller (lane order = sorted id order, lane 0 = root).
+    A dangling cause (weft gibberish) is outside the native domain."""
+    from .arrays import NodeArrays
+
+    na = NodeArrays.from_nodes_map(nodes_map, capacity=max(1, len(nodes_map)))
+    n = na.n
+    if n > 1 and (na.cause_idx[1:n] < 0).any():
+        raise _OutsideDomain()
+    return na.nodes, na.cause_idx[:n], na.vclass[:n]
+
+
+def _inverse_permutation(rank: np.ndarray) -> np.ndarray:
+    """rank is a bijection of 0..n-1; its inverse in O(n)."""
+    order = np.empty(rank.shape[0], np.intp)
+    order[rank] = np.arange(rank.shape[0], dtype=np.intp)
+    return order
 
 
 def refresh_list_weave(ct):
@@ -74,7 +71,7 @@ def refresh_list_weave(ct):
         rank = native.weave_list_ranks(cause_idx, vclass)
     except (RuntimeError, _OutsideDomain):
         return c_list.weave(ct.evolve(weaver="pure")).evolve(weaver=ct.weaver)
-    order = np.argsort(rank, kind="stable")
+    order = _inverse_permutation(rank)
     return ct.evolve(weave=[nodes[i] for i in order])
 
 
@@ -131,7 +128,7 @@ def refresh_map_weave(ct):
         )
     except (RuntimeError, _OutsideDomain):
         return c_map.weave(ct.evolve(weaver="pure")).evolve(weaver=ct.weaver)
-    order = np.argsort(rank, kind="stable")
+    order = _inverse_permutation(rank)
     weave: Dict = {}
     for i in order:
         nid, cause, value = nodes[i]

@@ -1,0 +1,29 @@
+"""Smoke tests for the benchmark CLI: every host config runs at tiny
+sizes and reports a sane JSON-able record on both host backends."""
+
+import pytest
+
+from cause_tpu import benchmarks, native
+
+
+@pytest.mark.parametrize("weaver", ["pure", "native"])
+def test_host_configs_run(weaver):
+    if weaver == "native" and not native.available():
+        pytest.skip("native toolchain unavailable")
+    records = [
+        benchmarks.config1_append_only(weaver, n=40, reps=1),
+        benchmarks.config2_concurrent_hide(weaver, n_per_site=10, reps=1),
+        benchmarks.config3_map_undo_redo(weaver, n_keys=4, overwrites=2,
+                                         reps=1),
+        benchmarks.config4_rich_text_base(weaver, paragraphs=2, para_len=8,
+                                          reps=1),
+    ]
+    for r in records:
+        assert r["value"] > 0 and r["unit"] and r["weaver"] == weaver
+
+
+def test_device_config_runs_smoke():
+    r = benchmarks.config5_batched_merge(
+        n_replicas=2, n_base=24, n_div=8, cap=64, reps=1
+    )
+    assert r["unit"] == "ms" and r["value"] > 0
