@@ -26,27 +26,11 @@ import time
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 
 from cause_tpu import benchgen
-from cause_tpu.weaver.jaxw import merge_weave_kernel
+from cause_tpu.benchgen import LANE_KEYS, merge_wave_scalar
 
 NORTH_STAR_MS = 100.0
-
-
-@jax.jit
-def _merge_wave_scalar(hi, lo, chi, clo, vc, valid):
-    """The timed program: the full batched merge+weave, reduced to one
-    checksum scalar so timing needs only a 4-byte transfer."""
-    order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(
-        hi, lo, chi, clo, vc, valid
-    )
-    return (
-        jnp.sum(rank.astype(jnp.float32))
-        + jnp.sum(order.astype(jnp.float32))
-        + jnp.sum(visible.astype(jnp.float32))
-        + jnp.sum(conflict.astype(jnp.float32))
-    )
 
 
 def main() -> None:
@@ -61,15 +45,15 @@ def main() -> None:
     batch = benchgen.batched_pair_lanes(
         n_replicas=B, n_base=n_base, n_div=n_div, capacity=cap, hide_every=8
     )
-    args = [jax.device_put(batch[k]) for k in ("hi", "lo", "chi", "clo", "vc", "valid")]
+    args = [jax.device_put(batch[k]) for k in LANE_KEYS]
 
     # compile + warmup (float() forces execution through the tunnel)
-    checksum = float(_merge_wave_scalar(*args))
+    float(merge_wave_scalar(*args))
 
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        float(_merge_wave_scalar(*args))
+        float(merge_wave_scalar(*args))
         times.append((time.perf_counter() - t0) * 1000.0)
     p50 = float(np.median(times))
 

@@ -34,7 +34,44 @@ from .weaver.arrays import (
     VCLASS_HIDE,
 )
 
-__all__ = ["chain_tree_lanes", "divergent_pair_lanes", "batched_pair_lanes"]
+__all__ = [
+    "chain_tree_lanes",
+    "divergent_pair_lanes",
+    "batched_pair_lanes",
+    "merge_wave_scalar",
+    "LANE_KEYS",
+]
+
+LANE_KEYS = ("hi", "lo", "chi", "clo", "vc", "valid")
+
+_scalar_program = None
+
+
+def merge_wave_scalar(*args):
+    """The shared timed program of the merge benchmarks (bench.py and
+    the CLI's config 5): the full batched merge+weave reduced to one
+    checksum scalar, because on the axon-tunneled TPU
+    ``jax.block_until_ready`` does not actually block and a 4-byte
+    device->host transfer is the only reliable sync point."""
+    global _scalar_program
+    if _scalar_program is None:
+        import jax
+        import jax.numpy as jnp
+
+        from .weaver.jaxw import merge_weave_kernel
+
+        @jax.jit
+        def scalar_out(*a):
+            order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(*a)
+            return (
+                jnp.sum(rank.astype(jnp.float32))
+                + jnp.sum(order.astype(jnp.float32))
+                + jnp.sum(visible.astype(jnp.float32))
+                + jnp.sum(conflict.astype(jnp.float32))
+            )
+
+        _scalar_program = scalar_out
+    return _scalar_program(*args)
 
 # synthetic site ranks (order-preserving: "0" sorts first, suffix sites
 # are minted after and sort above the base site by construction)

@@ -178,27 +178,15 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
     """Batched device merge of divergent replicas (north-star shape;
     sizes here are CLI defaults — bench.py runs the full 1024x10k)."""
     import jax
-    import jax.numpy as jnp
 
-    from .weaver.jaxw import merge_weave_kernel
-
-    @jax.jit
-    def scalar_out(*a):
-        order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(*a)
-        return (
-            jnp.sum(rank.astype(jnp.float32))
-            + jnp.sum(order.astype(jnp.float32))
-            + jnp.sum(visible.astype(jnp.float32))
-            + jnp.sum(conflict.astype(jnp.float32))
-        )
+    from .benchgen import LANE_KEYS, merge_wave_scalar
 
     batch = benchgen.batched_pair_lanes(
         n_replicas=n_replicas, n_base=n_base, n_div=n_div,
         capacity=cap, hide_every=8,
     )
-    args = [jax.device_put(batch[k])
-            for k in ("hi", "lo", "chi", "clo", "vc", "valid")]
-    float(scalar_out(*args))  # compile + warm
+    args = [jax.device_put(batch[k]) for k in LANE_KEYS]
+    float(merge_wave_scalar(*args))  # compile + warm
 
     ctx = (
         jax.profiler.trace(profile_dir)
@@ -206,7 +194,7 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
         else contextlib.nullcontext()
     )
     with ctx:
-        secs, _ = _timed(lambda: float(scalar_out(*args)), reps)
+        secs, _ = _timed(lambda: float(merge_wave_scalar(*args)), reps)
     return {
         "config": 5,
         "metric": f"batched merge, {n_replicas} pairs x "
@@ -240,11 +228,13 @@ def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-c", "--config", type=int, choices=sorted(CONFIGS),
                    help="run one config (default: all)")
-    p.add_argument("-w", "--weaver", default=None,
-                   help="weave backend for host configs (pure|native)")
+    p.add_argument("-w", "--weaver", default=None, choices=HOST_WEAVERS,
+                   help="weave backend for host configs")
     p.add_argument("--profile", metavar="DIR", default=None,
                    help="write a jax.profiler trace for device configs")
     args = p.parse_args(argv)
+
+    from . import native
 
     nums = [args.config] if args.config else sorted(CONFIGS)
     for num in nums:
@@ -253,6 +243,10 @@ def main(argv=None) -> None:
             continue
         weavers = [args.weaver] if args.weaver else list(HOST_WEAVERS)
         for w in weavers:
+            if w == "native" and not native.available():
+                print(json.dumps({"config": num, "weaver": "native",
+                                  "skipped": "native toolchain unavailable"}))
+                continue
             print(json.dumps(run_config(num, w)))
 
 

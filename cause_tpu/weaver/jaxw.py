@@ -43,6 +43,7 @@ across thousands of replicas.
 from __future__ import annotations
 
 import math
+from functools import partial
 from typing import Tuple
 
 import numpy as np
@@ -60,21 +61,23 @@ from .arrays import (
 
 __all__ = [
     "linearize",
+    "linearize_v2",
+    "estimate_runs",
     "weave_arrays",
     "refresh_list_weave",
     "merge_list_trees",
     "merge_weave_kernel",
+    "merge_weave_kernel_v2",
     "batched_merge_weave",
+    "batched_merge_weave_v2",
 ]
 
 
-def _child_sort(parent_sort, special, hi, lo):
-    """Group nodes under their parents in sibling order (specials first,
-    then descending id — ids compare as their (hi, lo) lanes). Returns
-    (first_child, next_sibling) as [N] node-index arrays (-1 = none)."""
-    N = hi.shape[0]
-    not_special = (~special).astype(jnp.int32)
-    order = jnp.lexsort((-lo, -hi, not_special, parent_sort))
+def _link_children(order, parent_sort):
+    """Given lanes sorted into sibling order (``order``) and each lane's
+    parent key, link the per-parent child lists: returns
+    (first_child, next_sibling) as [N] lane-index arrays (-1 = none)."""
+    N = parent_sort.shape[0]
     p = parent_sort[order]
     is_start = jnp.concatenate([jnp.ones((1,), bool), p[1:] != p[:-1]])
     same_parent_next = jnp.concatenate([p[1:] == p[:-1], jnp.zeros((1,), bool)])
@@ -89,9 +92,19 @@ def _child_sort(parent_sort, special, hi, lo):
     return first_child, next_sibling
 
 
-def _euler_rank(first_child, next_sibling, parent_up, valid):
-    """Preorder rank + subtree size via an Euler tour (2N edges:
-    d(i)=i, u(i)=N+i) and pointer-doubling suffix sums."""
+def _child_sort(parent_sort, special, hi, lo):
+    """Group nodes under their parents in sibling order (specials first,
+    then descending id — ids compare as their (hi, lo) lanes)."""
+    not_special = (~special).astype(jnp.int32)
+    order = jnp.lexsort((-lo, -hi, not_special, parent_sort))
+    return _link_children(order, parent_sort)
+
+
+def _euler_rank(first_child, next_sibling, parent_up, weights):
+    """Weighted preorder rank + subtree weight via an Euler tour (2N
+    edges: d(i)=i, u(i)=N+i) and pointer-doubling suffix sums. The rank
+    of node i is the total weight strictly before d(i) in the tour;
+    zero-weight nodes still occupy tour slots but displace nothing."""
     N = first_child.shape[0]
     idx = jnp.arange(N, dtype=jnp.int32)
     up = N + idx
@@ -102,7 +115,7 @@ def _euler_rank(first_child, next_sibling, parent_up, valid):
         jnp.where(parent_up >= 0, N + parent_up, up),
     )
     nxt = jnp.concatenate([next_d, next_u])
-    w = jnp.concatenate([valid.astype(jnp.int32), jnp.zeros(N, jnp.int32)])
+    w = jnp.concatenate([weights.astype(jnp.int32), jnp.zeros(N, jnp.int32)])
 
     steps = max(1, math.ceil(math.log2(2 * N)))
 
@@ -111,11 +124,11 @@ def _euler_rank(first_child, next_sibling, parent_up, valid):
         return val + val[nx], nx[nx]
 
     val, _ = lax.fori_loop(0, steps, body, (w, nxt))
-    s_down = val[:N]   # valid nodes at-or-after d(i) in the tour
-    s_up = val[N:]     # valid nodes at-or-after u(i)
-    m = jnp.sum(valid.astype(jnp.int32))
-    rank = jnp.where(valid, m - s_down, N).astype(jnp.int32)
-    size = jnp.where(valid, s_down - s_up, 0).astype(jnp.int32)
+    s_down = val[:N]   # weight at-or-after d(i) in the tour
+    s_up = val[N:]     # weight at-or-after u(i)
+    total = jnp.sum(weights.astype(jnp.int32))
+    rank = (total - s_down).astype(jnp.int32)
+    size = (s_down - s_up).astype(jnp.int32)
     return rank, size
 
 
@@ -155,7 +168,8 @@ def linearize(hi, lo, cause_idx, vclass, valid):
     parent_sort = jnp.where(valid & ~is_root, parent_t, N).astype(jnp.int32)
     fc, ns = _child_sort(parent_sort, special, hi, lo)
     parent_up = jnp.where(valid & ~is_root, parent_t, -1)
-    rank, _size = _euler_rank(fc, ns, parent_up, valid)
+    rank, _size = _euler_rank(fc, ns, parent_up, valid.astype(jnp.int32))
+    rank = jnp.where(valid, rank, N)
 
     # ---- visibility (hide?, list.cljc:48-55) via the weave successor.
     node_at = _scatter_by_rank(rank, valid, N)
@@ -176,17 +190,183 @@ def linearize(hi, lo, cause_idx, vclass, valid):
 _linearize_jit = jax.jit(linearize)
 
 
+def _host_jump(special, cause_safe, rel, max_steps):
+    """First non-special ancestor through the cause chain, by pointer
+    doubling under a convergence-tested while_loop: real special chains
+    are a few links deep (hide -> write; h.show -> hide), so this
+    usually stops after one or two rounds instead of log2(N)."""
+
+    def cond(c):
+        host, i = c
+        return (i < max_steps) & jnp.any(rel & special[host])
+
+    def body(c):
+        host, i = c
+        return jnp.where(special[host], host[host], host), i + 1
+
+    host, _ = lax.while_loop(cond, body, (cause_safe, jnp.int32(0)))
+    return host
+
+
+def linearize_v2(hi, lo, cause_idx, vclass, valid, k_max: int):
+    """Chain-compressed weave linearization.
+
+    Same contract as ``linearize`` — plus an ``overflow`` flag — but
+    the Euler-tour ranking (the gather-bound heart of v1) runs on a
+    contracted tree: maximal lane-adjacent single-child chains of the
+    derived tree T* collapse to one supernode each. Contraction needs
+    only elementwise ops, scans and scatters (a chain is lane-adjacent
+    precisely when a node's only T* child is the next lane, so run
+    membership falls out of one cumsum/cummax), and preorder positions
+    expand back as ``base[run] + offset-in-run``. Realistic causal
+    trees are append-heavy — long typing runs, few conflict branch
+    points — so K (number of runs) is typically orders of magnitude
+    below N and the pointer-doubling cost collapses with it.
+
+    ``k_max`` is the static capacity of the compressed tree. When the
+    input has more than ``k_max`` runs the outputs are invalid and
+    ``overflow`` is True: callers retry with a bigger bucket or fall
+    back to plain ``linearize`` (kept for exactly that role).
+    """
+    N = hi.shape[0]
+    idx = jnp.arange(N, dtype=jnp.int32)
+    is_root = valid & (idx == 0)
+    special = valid & (vclass > 0)
+    rel = valid & ~is_root
+
+    cause_safe = jnp.clip(cause_idx, 0, N - 1)
+    host = _host_jump(special, cause_safe, rel, max(1, math.ceil(math.log2(N))))
+
+    # ---- T* parents (lane-level, as v1)
+    parent_t = jnp.where(special, cause_safe, host)
+    parent = jnp.where(rel, parent_t, -1)
+
+    # ---- chain contraction
+    has_parent = parent >= 0
+    pc = jnp.clip(parent, 0, N - 1)
+    child_count = (
+        jnp.zeros(N + 1, jnp.int32)
+        .at[jnp.where(has_parent, pc, N)]
+        .add(1)[:N]
+    )
+    only_child = has_parent & (child_count[pc] == 1)
+    glued = only_child & (parent == idx - 1)  # lane-adjacent single child
+    run_start = valid & ~glued
+    run_id = jnp.cumsum(run_start.astype(jnp.int32)) - 1
+    n_runs = jnp.sum(run_start.astype(jnp.int32))
+    overflow = n_runs > k_max
+    last_start = lax.cummax(jnp.where(run_start, idx, -1))
+    offset = idx - last_start
+
+    # ---- compacted run arrays (slot k_max is the discard sentinel)
+    rid_ok = run_start & (run_id < k_max)
+    slot = jnp.where(rid_ok, run_id, k_max)
+    head_lane = jnp.full(k_max + 1, -1, jnp.int32).at[slot].set(idx)[:k_max]
+    head_special = (
+        jnp.zeros(k_max + 1, bool).at[slot].set(special)[:k_max]
+    )
+    head_parent = jnp.full(k_max + 1, -1, jnp.int32).at[slot].set(parent)[:k_max]
+    lane_ok = valid & (run_id < k_max) & (run_id >= 0)
+    run_len = (
+        jnp.zeros(k_max + 1, jnp.int32)
+        .at[jnp.where(lane_ok, run_id, k_max)]
+        .add(1)[:k_max]
+    )
+    valid_run = head_lane >= 0
+    parent_run = jnp.where(
+        head_parent >= 0,
+        run_id[jnp.clip(head_parent, 0, N - 1)],
+        -1,
+    ).astype(jnp.int32)
+
+    # ---- sibling sort over runs: 2 int32 keys (packed parent+class,
+    # then descending head lane — lanes are id-sorted, so lane order is
+    # id order)
+    parent_sort = jnp.where(valid_run & (parent_run >= 0), parent_run, k_max)
+    packed = parent_sort * 2 + (~head_special).astype(jnp.int32)
+    order = jnp.lexsort((-head_lane, packed))
+    fc, ns = _link_children(order, parent_sort)
+    parent_up = jnp.where(valid_run & (parent_run >= 0), parent_run, -1)
+    base, _ = _euler_rank(
+        fc, ns, parent_up, jnp.where(valid_run, run_len, 0)
+    )
+
+    # ---- expand: every run's lanes are contiguous in the preorder
+    rank = jnp.where(
+        valid, base[jnp.clip(run_id, 0, k_max - 1)] + offset, N
+    ).astype(jnp.int32)
+
+    # ---- visibility (identical to v1)
+    node_at = _scatter_by_rank(rank, valid, N)
+    succ = node_at[jnp.clip(rank, 0, N) + 1]
+    succ_safe = jnp.clip(succ, 0, N - 1)
+    succ_is_hide = (
+        (succ >= 0)
+        & (
+            (vclass[succ_safe] == VCLASS_HIDE)
+            | (vclass[succ_safe] == VCLASS_H_HIDE)
+        )
+        & (cause_idx[succ_safe] == idx)
+    )
+    visible = valid & (vclass == 0) & ~is_root & ~succ_is_hide
+    return rank, visible, overflow
+
+
+_linearize_v2_jit = jax.jit(linearize_v2, static_argnames="k_max")
+
+
+def estimate_runs(cause_idx, vclass, valid) -> int:
+    """Host-side (numpy) count of the chain-contracted tree's runs —
+    the same contraction ``linearize_v2`` performs, so the device
+    kernel can be chosen before dispatch instead of retrying after an
+    overflow."""
+    cause_idx = np.asarray(cause_idx)
+    vclass = np.asarray(vclass)
+    valid = np.asarray(valid)
+    n = cause_idx.shape[0]
+    idx = np.arange(n, dtype=np.int32)
+    is_root = valid & (idx == 0)
+    special = valid & (vclass > 0)
+    rel = valid & ~is_root
+    cause_safe = np.clip(cause_idx, 0, n - 1)
+    host = cause_safe.copy()
+    for _ in range(max(1, math.ceil(math.log2(max(2, n))))):
+        on_special = special[host] & rel
+        if not on_special.any():
+            break
+        host = np.where(on_special, host[host], host)
+    parent = np.where(rel, np.where(special, cause_safe, host), -1)
+    has_parent = parent >= 0
+    pc = np.clip(parent, 0, n - 1)
+    child_count = np.bincount(pc[has_parent], minlength=n)
+    only_child = has_parent & (child_count[pc] == 1)
+    glued = only_child & (parent == idx - 1)
+    return int((valid & ~glued).sum())
+
+
+def _run_budget(capacity: int) -> int:
+    return max(16, capacity // 8)
+
+
 def weave_arrays(na: NodeArrays) -> Tuple[np.ndarray, np.ndarray]:
     """Run the device linearization for one tree; returns host-side
-    ``(rank, visible)`` numpy arrays."""
+    ``(rank, visible)`` numpy arrays. Uses the chain-compressed kernel
+    when the tree's run count fits the budget (computed host-side, so
+    a branchy tree never pays for a doomed v2 dispatch)."""
     hi, lo = na.id_lanes()
-    rank, visible = _linearize_jit(
+    args = (
         jnp.asarray(hi),
         jnp.asarray(lo),
         jnp.asarray(na.cause_idx),
         jnp.asarray(na.vclass),
         jnp.asarray(na.valid),
     )
+    k_max = _run_budget(na.capacity)
+    if estimate_runs(na.cause_idx, na.vclass, na.valid) <= k_max:
+        rank, visible, overflow = _linearize_v2_jit(*args, k_max=k_max)
+        if not bool(overflow):  # belt and braces: estimate is exact
+            return np.asarray(rank), np.asarray(visible)
+    rank, visible = _linearize_jit(*args)
     return np.asarray(rank), np.asarray(visible)
 
 
@@ -230,6 +410,31 @@ def merge_weave_kernel(hi, lo, cause_hi, cause_lo, vclass, valid):
     bodies (value payloads stay host-side; host equality still governs
     the strict check on the API path).
     """
+    order, sorted_lanes = _merge_front_half(hi, lo, cause_hi, cause_lo,
+                                            vclass, valid)
+    hi_s, lo_s, ci, vclass_s, keep, conflict = sorted_lanes
+    rank, visible = linearize(hi_s, lo_s, ci, vclass_s, keep)
+    return order, rank, visible, conflict
+
+
+def merge_weave_kernel_v2(hi, lo, cause_hi, cause_lo, vclass, valid,
+                          k_max: int):
+    """The merge kernel with the chain-compressed linearizer: identical
+    union/cause-resolution front half, v2 back half. Returns
+    ``(order, rank, visible, conflict, overflow)``; on overflow the
+    rank/visible lanes are invalid and the caller falls back to the
+    uncompressed kernel."""
+    order, sorted_lanes = _merge_front_half(hi, lo, cause_hi, cause_lo,
+                                            vclass, valid)
+    hi_s, lo_s, ci, vclass_s, keep, conflict = sorted_lanes
+    rank, visible, overflow = linearize_v2(hi_s, lo_s, ci, vclass_s, keep,
+                                           k_max)
+    return order, rank, visible, conflict, overflow
+
+
+def _merge_front_half(hi, lo, cause_hi, cause_lo, vclass, valid):
+    """Shared union + cause resolution of the merge kernels: id lexsort,
+    duplicate drop, conflict detection, sort-join cause resolution."""
     M = hi.shape[0]
     order = jnp.lexsort((lo, hi))
     hi_s, lo_s = hi[order], lo[order]
@@ -241,7 +446,6 @@ def merge_weave_kernel(hi, lo, cause_hi, cause_lo, vclass, valid):
     keep = valid_s & ~dup
     vclass_s = vclass[order]
     chi_s, clo_s = cause_hi[order], cause_lo[order]
-    # conflict: a dropped duplicate whose lanes disagree
     prev_chi = jnp.concatenate([chi_s[:1], chi_s[:-1]])
     prev_clo = jnp.concatenate([clo_s[:1], clo_s[:-1]])
     prev_vc = jnp.concatenate([vclass_s[:1], vclass_s[:-1]])
@@ -250,11 +454,6 @@ def merge_weave_kernel(hi, lo, cause_hi, cause_lo, vclass, valid):
         & valid_s
         & ((chi_s != prev_chi) | (clo_s != prev_clo) | (vclass_s != prev_vc))
     )
-    # ---- sort-join cause resolution: 2M records = kept node keys
-    # (kind 0) + per-lane cause queries (kind 1). After the lexsort each
-    # query directly follows the node records for its key; cummax over
-    # kept-node record positions forward-fills "the last kept node lane
-    # at or before me in key order".
     rec_hi = jnp.concatenate([jnp.where(keep, hi_s, I32_MAX), chi_s])
     rec_lo = jnp.concatenate([jnp.where(keep, lo_s, I32_MAX), clo_s])
     rec_kind = jnp.concatenate(
@@ -279,9 +478,21 @@ def merge_weave_kernel(hi, lo, cause_hi, cause_lo, vclass, valid):
         .at[q_lane]
         .set(answer)[:M]
     )
-    rank, visible = linearize(hi_s, lo_s, ci, vclass_s, keep)
-    return order, rank, visible, conflict
+    return order, (hi_s, lo_s, ci, vclass_s, keep, conflict)
 
 
 # vmapped batch: [B, M] lanes -> per-replica weave ranks
 batched_merge_weave = jax.jit(jax.vmap(merge_weave_kernel))
+
+
+@partial(jax.jit, static_argnames="k_max")
+def batched_merge_weave_v2(hi, lo, cause_hi, cause_lo, vclass, valid,
+                           k_max: int):
+    """Chain-compressed batch; ``k_max`` is the per-replica run budget.
+    When any row overflows it the caller re-runs the uncompressed
+    batch (check ``overflow.any()``)."""
+
+    def row(h, l, ch, cl, vc, va):
+        return merge_weave_kernel_v2(h, l, ch, cl, vc, va, k_max)
+
+    return jax.vmap(row)(hi, lo, cause_hi, cause_lo, vclass, valid)

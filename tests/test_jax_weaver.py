@@ -161,6 +161,76 @@ def decode_device_weave(order_row, rank_row, all_nodes, visible_row=None):
     return weave, [n for _, n in vis]
 
 
+def test_linearize_v2_parity():
+    """The chain-compressed linearizer matches v1 on the regression
+    corpus, fuzz trees, and append-only chains (its best case)."""
+    import jax.numpy as jnp
+    from cause_tpu.weaver.arrays import NodeArrays
+
+    rng = random.Random(0xD00D)
+    trees = []
+    for nodes in EDGE_CASES:
+        cl = c.clist()
+        for n in nodes:
+            cl = cl.insert(n)
+        trees.append(cl.ct)
+    for _ in range(25):
+        sites = [new_site_id() for _ in range(4)]
+        cl = c.clist()
+        for _ in range(rng.randrange(1, 16)):
+            cl = cl.insert(rand_node(rng, cl, site_id=rng.choice(sites)))
+        trees.append(cl.ct)
+    trees.append(c.clist(*"a long append only typing run").ct)
+    for ti, ct in enumerate(trees):
+        na = NodeArrays.from_nodes_map(ct.nodes)
+        hi, lo = na.id_lanes()
+        args = tuple(map(jnp.asarray, (hi, lo, na.cause_idx, na.vclass,
+                                       na.valid)))
+        r1, v1 = jaxw.linearize(*args)
+        r2, v2, ovf = jaxw.linearize_v2(*args, k_max=na.capacity)
+        assert not bool(ovf)
+        assert np.array_equal(np.asarray(r1), np.asarray(r2)), f"tree {ti}"
+        assert np.array_equal(np.asarray(v1), np.asarray(v2)), f"tree {ti}"
+
+
+def test_linearize_v2_overflow_flag():
+    """A run budget below the real run count must raise the flag."""
+    import jax.numpy as jnp
+    from cause_tpu.weaver.arrays import NodeArrays
+
+    # star tree: every node caused by root -> every node its own run
+    cl = c.clist()
+    for i in range(1, 9):
+        cl = cl.insert(((i, "siteA________", 0), c.root_id, f"v{i}"))
+    na = NodeArrays.from_nodes_map(cl.ct.nodes)
+    hi, lo = na.id_lanes()
+    args = tuple(map(jnp.asarray, (hi, lo, na.cause_idx, na.vclass,
+                                   na.valid)))
+    *_, ovf_small = jaxw.linearize_v2(*args, k_max=4)
+    assert bool(ovf_small)
+    r2, v2, ovf_big = jaxw.linearize_v2(*args, k_max=16)
+    assert not bool(ovf_big)
+    r1, v1 = jaxw.linearize(*args)
+    assert np.array_equal(np.asarray(r1), np.asarray(r2))
+
+
+def test_batched_merge_v2_parity():
+    """The compressed batched merge kernel equals the v1 kernel."""
+    rng = random.Random(77)
+    B, cap = 3, 32
+    pairs, stack, metas = build_batch(rng, B, cap)
+    args = [stack[k] for k in ("hi", "lo", "chi", "clo", "vc", "valid")]
+    o1, r1, v1, c1 = map(np.asarray, jaxw.batched_merge_weave(*args))
+    o2, r2, v2, c2, ovf = map(
+        np.asarray, jaxw.batched_merge_weave_v2(*args, k_max=2 * cap)
+    )
+    assert not ovf.any()
+    assert np.array_equal(o1, o2)
+    assert np.array_equal(r1, r2)
+    assert np.array_equal(v1, v2)
+    assert np.array_equal(c1, c2)
+
+
 def test_batched_merge_kernel_parity():
     """The fully-on-device union kernel agrees with pure pairwise merge."""
     rng = random.Random(2024)
