@@ -47,13 +47,19 @@ def main() -> None:
     )
     args = [jax.device_put(batch[k]) for k in LANE_KEYS]
 
-    # compile + warmup (float() forces execution through the tunnel)
-    float(merge_wave_scalar(*args))
+    k_max = benchgen.pair_run_budget(n_div)
 
+    def step() -> None:
+        # one transfer fetches checksum + overflow and forces execution
+        out = np.asarray(merge_wave_scalar(*args, k_max=k_max))
+        if out[1]:  # overflowed rows carry garbage ranks
+            raise SystemExit("run budget overflow — raise k_max")
+
+    step()  # compile + warmup
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        float(merge_wave_scalar(*args))
+        step()
         times.append((time.perf_counter() - t0) * 1000.0)
     p50 = float(np.median(times))
 

@@ -44,25 +44,39 @@ __all__ = [
 
 LANE_KEYS = ("hi", "lo", "chi", "clo", "vc", "valid")
 
-_scalar_program = None
+def pair_run_budget(n_div: int) -> int:
+    """Chain-contracted run count bound for one ``divergent_pair_lanes``
+    merge. The base chain compresses to one run, but the two suffixes
+    interleave in id order (same ts range, different sites), so no
+    suffix node is kept-lane-adjacent to its cause and every suffix
+    node is its own run: runs ~= 2*n_div + small constants. Measured:
+    201 runs for n_div=100."""
+    return 2 * n_div + 64
 
 
-def merge_wave_scalar(*args):
+_scalar_programs: Dict = {}
+
+
+def merge_wave_scalar(*args, k_max: int = 0):
     """The shared timed program of the merge benchmarks (bench.py and
     the CLI's config 5): the full batched merge+weave reduced to one
     checksum scalar, because on the axon-tunneled TPU
     ``jax.block_until_ready`` does not actually block and a 4-byte
-    device->host transfer is the only reliable sync point."""
-    global _scalar_program
-    if _scalar_program is None:
+    device->host transfer is the only reliable sync point.
+
+    ``k_max`` > 0 selects the chain-compressed kernel with that run
+    budget and returns a length-2 device array ``[checksum,
+    n_overflowed_rows]`` (one transfer fetches both); the default 0
+    runs the uncompressed kernel and returns just the checksum.
+    """
+    program = _scalar_programs.get(k_max)
+    if program is None:
         import jax
         import jax.numpy as jnp
 
-        from .weaver.jaxw import merge_weave_kernel
+        from .weaver.jaxw import batched_merge_weave_v2, merge_weave_kernel
 
-        @jax.jit
-        def scalar_out(*a):
-            order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(*a)
+        def _checksum(order, rank, visible, conflict):
             return (
                 jnp.sum(rank.astype(jnp.float32))
                 + jnp.sum(order.astype(jnp.float32))
@@ -70,8 +84,23 @@ def merge_wave_scalar(*args):
                 + jnp.sum(conflict.astype(jnp.float32))
             )
 
-        _scalar_program = scalar_out
-    return _scalar_program(*args)
+        if k_max > 0:
+            @jax.jit
+            def program(*a):
+                order, rank, visible, conflict, overflow = (
+                    batched_merge_weave_v2(*a, k_max=k_max)
+                )
+                return jnp.stack([
+                    _checksum(order, rank, visible, conflict),
+                    jnp.sum(overflow.astype(jnp.float32)),
+                ])
+        else:
+            @jax.jit
+            def program(*a):
+                return _checksum(*jax.vmap(merge_weave_kernel)(*a))
+
+        _scalar_programs[k_max] = program
+    return program(*args)
 
 # synthetic site ranks (order-preserving: "0" sorts first, suffix sites
 # are minted after and sort above the base site by construction)

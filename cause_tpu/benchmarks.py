@@ -186,15 +186,24 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
         capacity=cap, hide_every=8,
     )
     args = [jax.device_put(batch[k]) for k in LANE_KEYS]
-    float(merge_wave_scalar(*args))  # compile + warm
+    k_max = benchgen.pair_run_budget(n_div)
 
+    def step():
+        import numpy as _np
+
+        out = _np.asarray(merge_wave_scalar(*args, k_max=k_max))
+        if out[1]:
+            raise RuntimeError("run budget overflow — raise k_max")
+        return out[0]
+
+    step()  # compile + warm
     ctx = (
         jax.profiler.trace(profile_dir)
         if profile_dir
         else contextlib.nullcontext()
     )
     with ctx:
-        secs, _ = _timed(lambda: float(merge_wave_scalar(*args)), reps)
+        secs, _ = _timed(step, reps)
     return {
         "config": 5,
         "metric": f"batched merge, {n_replicas} pairs x "

@@ -211,7 +211,12 @@ def _host_jump(special, cause_safe, rel, max_steps):
 def linearize_v2(hi, lo, cause_idx, vclass, valid, k_max: int):
     """Chain-compressed weave linearization.
 
-    Same contract as ``linearize`` — plus an ``overflow`` flag — but
+    Same inputs/outputs as ``linearize`` — plus an ``overflow`` flag —
+    with one extra precondition: valid lanes must arrive in ascending
+    id order (sibling order is derived from lane position instead of
+    the hi/lo id lanes). Both in-tree callers guarantee it — the merge
+    front half id-sorts, and ``NodeArrays.from_nodes_map`` builds lanes
+    sorted; hand-built unsorted lanes must use ``linearize``. But
     the Euler-tour ranking (the gather-bound heart of v1) runs on a
     contracted tree: maximal lane-adjacent single-child chains of the
     derived tree T* collapse to one supernode each. Contraction needs
@@ -241,7 +246,11 @@ def linearize_v2(hi, lo, cause_idx, vclass, valid, k_max: int):
     parent_t = jnp.where(special, cause_safe, host)
     parent = jnp.where(rel, parent_t, -1)
 
-    # ---- chain contraction
+    # ---- chain contraction over *kept-lane* positions: dropped
+    # duplicates and padding occupy lanes (the merge kernel interleaves
+    # them with kept nodes), so adjacency is measured in the compacted
+    # valid-lane numbering, not raw lane index.
+    kidx = jnp.cumsum(valid.astype(jnp.int32)) - 1
     has_parent = parent >= 0
     pc = jnp.clip(parent, 0, N - 1)
     child_count = (
@@ -250,13 +259,13 @@ def linearize_v2(hi, lo, cause_idx, vclass, valid, k_max: int):
         .add(1)[:N]
     )
     only_child = has_parent & (child_count[pc] == 1)
-    glued = only_child & (parent == idx - 1)  # lane-adjacent single child
+    glued = only_child & (kidx[pc] == kidx - 1)  # adjacent among kept
     run_start = valid & ~glued
     run_id = jnp.cumsum(run_start.astype(jnp.int32)) - 1
     n_runs = jnp.sum(run_start.astype(jnp.int32))
     overflow = n_runs > k_max
-    last_start = lax.cummax(jnp.where(run_start, idx, -1))
-    offset = idx - last_start
+    # offset within run, again in kept-lane numbering
+    offset = kidx - lax.cummax(jnp.where(run_start, kidx, -1))
 
     # ---- compacted run arrays (slot k_max is the discard sentinel)
     rid_ok = run_start & (run_id < k_max)
@@ -336,11 +345,12 @@ def estimate_runs(cause_idx, vclass, valid) -> int:
             break
         host = np.where(on_special, host[host], host)
     parent = np.where(rel, np.where(special, cause_safe, host), -1)
+    kidx = np.cumsum(valid.astype(np.int32)) - 1
     has_parent = parent >= 0
     pc = np.clip(parent, 0, n - 1)
     child_count = np.bincount(pc[has_parent], minlength=n)
     only_child = has_parent & (child_count[pc] == 1)
-    glued = only_child & (parent == idx - 1)
+    glued = only_child & (kidx[pc] == kidx - 1)
     return int((valid & ~glued).sum())
 
 
