@@ -124,6 +124,13 @@ def merge(causal1, causal2):
     return causal1.merge(causal2)
 
 
+def merge_all(causal, *more):
+    """Converge a whole fleet of replicas in one pass (N-way node union
+    + one reweave). Equal to folding ``merge``, much faster on the
+    native/jax backends."""
+    return causal.merge_many(more)
+
+
 def get_weave(causal):
     """The woven cache of nodes (protocols.cljc:14-15)."""
     return causal.get_weave()
@@ -180,6 +187,7 @@ __all__ = [
     "append",
     "weft",
     "merge",
+    "merge_all",
     "get_weave",
     "get_nodes",
     "causal_to_edn",

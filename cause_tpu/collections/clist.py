@@ -190,6 +190,16 @@ class CausalList:
             return CausalList(nativew.merge_trees(self.ct, other.ct))
         return CausalList(s.merge_trees(weave, self.ct, other.ct))
 
+    def merge_many(self, others) -> "CausalList":
+        """Converge a whole fleet in one pass: N-way node union + one
+        full reweave (the weave is a pure function of the node set, so
+        this equals any fold of pairwise merges). No reference
+        analogue — the reference folds pairwise (shared.cljc:300-314)."""
+        ct = s.union_nodes_many(
+            [self.ct] + [o.ct for o in others]
+        )
+        return CausalList(weave(ct))
+
     # -- CausalTo (protocols.cljc:33-35) --
     def causal_to_edn(self, opts: Optional[dict] = None) -> list:
         return causal_list_to_edn(self.ct, opts)

@@ -227,6 +227,12 @@ class CausalMap:
             return CausalMap(nativew.merge_trees(self.ct, other.ct))
         return CausalMap(s.merge_trees(weave, self.ct, other.ct))
 
+    def merge_many(self, others) -> "CausalMap":
+        """Converge a whole fleet in one pass: N-way node union + one
+        full reweave (equals any fold of pairwise merges)."""
+        ct = s.union_nodes_many([self.ct] + [o.ct for o in others])
+        return CausalMap(weave(ct))
+
     # -- CausalTo --
     def causal_to_edn(self, opts: Optional[dict] = None) -> dict:
         return causal_map_to_edn(self.ct, opts)

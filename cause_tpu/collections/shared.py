@@ -51,6 +51,7 @@ __all__ = [
     "weft",
     "check_mergeable",
     "union_nodes",
+    "union_nodes_many",
     "merge_trees",
     "causal_to_edn",
 ]
@@ -259,23 +260,47 @@ def union_nodes(ct1: CausalTree, ct2: CausalTree) -> CausalTree:
     stores (append-only conflict check, as in ``insert``), fast-forward
     the lamport clock, and respin the yarns. The caller reweaves with
     its backend. Shared by the jax and native merge paths."""
-    check_mergeable(ct1, ct2)
-    nodes = dict(ct1.nodes)
-    max_new_ts = ct1.lamport_ts
-    for nid, body in ct2.nodes.items():
-        existing = nodes.get(nid)
-        if existing is not None:
-            if existing != body:
-                raise CausalError(
-                    "This node is already in the tree and can't be changed.",
-                    {"causes": {"append-only", "edits-not-allowed"},
-                     "existing_node": (nid,) + existing},
-                )
-            continue
-        if nid[0] > max_new_ts:
-            max_new_ts = nid[0]
-        nodes[nid] = body
-    ct = ct1.evolve(nodes=nodes, lamport_ts=max_new_ts)
+    return union_nodes_many((ct1, ct2))
+
+
+def union_nodes_many(cts) -> CausalTree:
+    """N-way ``union_nodes``: one guard+union pass over a whole fleet of
+    replicas, one respin. The weave being a pure function of the node
+    set makes this equal to any fold of pairwise merges — including the
+    validations: foreign nodes new to the union must have their
+    id-shaped cause somewhere in it (insert's cause-must-exist check,
+    shared.cljc:175-178; duplicates skip validation there too)."""
+    cts = list(cts)
+    if not cts:
+        raise CausalError("Nothing to merge.", {"causes": {"empty-fleet"}})
+    first = cts[0]
+    nodes = dict(first.nodes)
+    max_new_ts = first.lamport_ts
+    added = []
+    for ct in cts[1:]:
+        check_mergeable(first, ct)
+        for nid, body in ct.nodes.items():
+            existing = nodes.get(nid)
+            if existing is not None:
+                if existing != body:
+                    raise CausalError(
+                        "This node is already in the tree and can't be changed.",
+                        {"causes": {"append-only", "edits-not-allowed"},
+                         "existing_node": (nid,) + existing},
+                    )
+                continue
+            if nid[0] > max_new_ts:
+                max_new_ts = nid[0]
+            nodes[nid] = body
+            added.append(nid)
+    for nid in added:
+        cause = nodes[nid][0]
+        if not is_key(cause) and cause not in nodes:
+            raise CausalError(
+                "The cause of this node is not in the tree.",
+                {"causes": {"cause-must-exist"}, "node": (nid,) + nodes[nid]},
+            )
+    ct = first.evolve(nodes=nodes, lamport_ts=max_new_ts)
     return spin(ct)
 
 

@@ -1,0 +1,90 @@
+"""Fleet convergence: merge_all (N-way union + one reweave) must equal
+any fold of pairwise merges, on every backend."""
+
+import random
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import native
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections import cmap as c_map
+from cause_tpu.ids import K, new_site_id
+
+from test_list import rand_node
+
+
+def build_fleet(weaver, n_replicas=6, n_edits=5, seed=11):
+    rng = random.Random(seed)
+    base = c.clist(*"seed", weaver=weaver)
+    fleet = []
+    for _ in range(n_replicas):
+        r = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
+        for _ in range(n_edits):
+            r = r.insert(rand_node(rng, r, site_id=r.ct.site_id))
+        fleet.append(r)
+    return fleet
+
+
+def fold_merge(fleet):
+    out = fleet[0]
+    for r in fleet[1:]:
+        out = out.merge(r)
+    return out
+
+
+@pytest.mark.parametrize("weaver", ["pure", "native", "jax"])
+def test_merge_all_equals_fold(weaver):
+    if weaver == "native" and not native.available():
+        pytest.skip("native toolchain unavailable")
+    fleet = build_fleet(weaver)
+    folded = fold_merge(fleet)
+    converged = c.merge_all(fleet[0], *fleet[1:])
+    assert converged.ct.nodes == folded.ct.nodes
+    assert converged.ct.weave == folded.ct.weave
+    assert converged.ct.lamport_ts == folded.ct.lamport_ts
+    assert converged.causal_to_edn() == folded.causal_to_edn()
+
+
+def test_merge_all_order_invariant():
+    fleet = build_fleet("pure", seed=23)
+    a = c.merge_all(fleet[0], *fleet[1:])
+    b = c.merge_all(fleet[-1], *reversed(fleet[:-1]))
+    assert a.causal_to_edn() == b.causal_to_edn()
+    assert a.ct.nodes == b.ct.nodes
+
+
+def test_merge_all_maps():
+    base = c.cmap(weaver="pure").assoc(K("k"), "v0")
+    fleet = [
+        c_map.CausalMap(base.ct.evolve(site_id=new_site_id())).assoc(
+            K(f"k{i}"), f"v{i}"
+        )
+        for i in range(4)
+    ]
+    folded = fold_merge(fleet)
+    converged = c.merge_all(fleet[0], *fleet[1:])
+    assert converged.ct.nodes == folded.ct.nodes
+    assert converged.ct.weave == folded.ct.weave
+    assert converged.causal_to_edn() == folded.causal_to_edn()
+
+
+def test_merge_all_guards():
+    with pytest.raises(c.CausalError):
+        c.merge_all(c.clist("a"), c.clist("b"))
+
+
+def test_merge_all_validates_dangling_cause():
+    """A foreign node whose cause is nowhere in the union must raise,
+    exactly as the pairwise fold does (insert's cause-must-exist)."""
+    from cause_tpu.collections import shared as s
+
+    a = c.clist("a")
+    b = c_list.CausalList(a.ct.evolve(site_id=new_site_id()))
+    bad_nodes = dict(b.ct.nodes)
+    bad_nodes[(9, b.ct.site_id, 0)] = ((7, "ghost________", 0), "X")
+    bad = b.ct.evolve(nodes=bad_nodes)
+    with pytest.raises(c.CausalError):
+        s.union_nodes_many([a.ct, bad])
+    with pytest.raises(c.CausalError):
+        s.union_nodes_many([])
