@@ -29,7 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..weaver.jaxw import merge_weave_kernel
+from ..weaver.jaxw import merge_weave_kernel, merge_weave_kernel_v2
 
 try:  # JAX >= 0.4.35 exports shard_map at the top level
     from jax import shard_map as _shard_map
@@ -75,9 +75,11 @@ def replica_digest(hi_sorted, lo_sorted, rank, visible):
 
 
 @lru_cache(maxsize=8)
-def _sharded_step(mesh: Mesh):
+def _sharded_step(mesh: Mesh, k_max: int):
     """The jitted sharded merge step for one mesh (cached so repeat
-    merge waves hit the jit cache instead of re-tracing)."""
+    merge waves hit the jit cache instead of re-tracing). ``k_max`` > 0
+    runs the chain-compressed kernel with that run budget (overflowed
+    rows are psum-counted fleet-wide); 0 runs the uncompressed kernel."""
     axis = mesh.axis_names[0]
     sharded = P(axis)
     replicated = P()
@@ -86,28 +88,42 @@ def _sharded_step(mesh: Mesh):
         _shard_map,
         mesh=mesh,
         in_specs=(sharded,) * 6,
-        out_specs=(sharded, sharded, sharded, sharded, replicated, replicated),
+        out_specs=(sharded, sharded, sharded, sharded, replicated,
+                   replicated, replicated),
     )
     def step(hi, lo, chi, clo, vc, va):
-        order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(
-            hi, lo, chi, clo, vc, va
-        )
+        if k_max > 0:
+            order, rank, visible, conflict, overflow = jax.vmap(
+                lambda *r: merge_weave_kernel_v2(*r, k_max)
+            )(hi, lo, chi, clo, vc, va)
+            n_overflow = lax.psum(jnp.sum(overflow.astype(jnp.int32)), axis)
+        else:
+            order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(
+                hi, lo, chi, clo, vc, va
+            )
+            n_overflow = lax.psum(jnp.zeros((), jnp.int32), axis)
         hi_sorted = jnp.take_along_axis(hi, order, axis=1)
         lo_sorted = jnp.take_along_axis(lo, order, axis=1)
         digest = jax.vmap(replica_digest)(hi_sorted, lo_sorted, rank, visible)
         total_visible = lax.psum(jnp.sum(visible.astype(jnp.int32)), axis)
         n_conflicts = lax.psum(jnp.sum(conflict.astype(jnp.int32)), axis)
-        return order, rank, visible, digest, total_visible, n_conflicts
+        return (order, rank, visible, digest, total_visible, n_conflicts,
+                n_overflow)
 
     return jax.jit(step)
 
 
-def sharded_merge_weave(mesh: Mesh, hi, lo, cause_hi, cause_lo, vclass, valid):
+def sharded_merge_weave(mesh: Mesh, hi, lo, cause_hi, cause_lo, vclass, valid,
+                        k_max: int = 0):
     """Run the batched merge+weave with the replica axis sharded over
     the mesh. Returns per-replica ``(order, rank, visible, digest)``
-    (sharded) plus fleet-level ``(total_visible, n_conflicts)`` reduced
-    with psum over the mesh axis.
+    (sharded) plus fleet-level ``(total_visible, n_conflicts,
+    n_overflow)`` reduced with psum over the mesh axis. ``k_max`` > 0
+    selects the chain-compressed kernel with that per-replica run
+    budget; rows counted in ``n_overflow`` carry invalid ranks and the
+    caller should rerun with ``k_max=0`` (or a bigger budget).
 
     The batch dimension must be divisible by the mesh size.
     """
-    return _sharded_step(mesh)(hi, lo, cause_hi, cause_lo, vclass, valid)
+    return _sharded_step(mesh, k_max)(hi, lo, cause_hi, cause_lo, vclass,
+                                      valid)

@@ -1,0 +1,59 @@
+"""Compare the v1 and v2 merge+weave kernels at configurable scales.
+
+Run with a small batch first; the tunnel wedges if a huge program is
+killed mid-flight. Timing uses the checksum-transfer sync (see
+cause_tpu.benchgen.merge_wave_scalar).
+
+Usage: python scripts/tpu_kernel_bench.py [B] [n_base] [n_div] [reps]
+Defaults: 64 9000 1000 3  (one-sixteenth of the north-star batch).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS, merge_wave_scalar, pair_run_budget
+
+
+def main():
+    B = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    n_base = int(sys.argv[2]) if len(sys.argv) > 2 else 9000
+    n_div = int(sys.argv[3]) if len(sys.argv) > 3 else 1000
+    reps = int(sys.argv[4]) if len(sys.argv) > 4 else 3
+    cap = 1 + n_base + n_div + 239
+    cap += (-cap) % 256
+    print(f"B={B} nodes/tree={1 + n_base + n_div} cap={cap} "
+          f"devices={jax.devices()}", flush=True)
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=n_base, n_div=n_div, capacity=cap, hide_every=8
+    )
+    args = [jax.device_put(batch[k]) for k in LANE_KEYS]
+
+    for label, k_max in (("v1", 0), ("v2", pair_run_budget(n_div))):
+        t0 = time.perf_counter()
+        out = np.asarray(merge_wave_scalar(*args, k_max=k_max))
+        print(f"{label}: compile+first {time.perf_counter() - t0:.1f}s",
+              flush=True)
+        if k_max and out[1]:
+            print(f"{label}: OVERFLOW ({int(out[1])} rows)", flush=True)
+            continue
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(merge_wave_scalar(*args, k_max=k_max))
+            times.append((time.perf_counter() - t0) * 1e3)
+        p50 = float(np.median(times))
+        per_pair = p50 / B
+        print(f"{label}: p50 {p50:.1f} ms  ({per_pair:.3f} ms/pair; "
+              f"x1024 projects to {per_pair * 1024:.0f} ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

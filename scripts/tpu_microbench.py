@@ -1,0 +1,151 @@
+"""TPU primitive microbenchmarks for the weave kernel's building blocks.
+
+Methodology notes (learned the hard way on the axon-tunneled TPU):
+
+- ``jax.block_until_ready`` does NOT block through the tunnel; the only
+  reliable sync is a device->host transfer of a scalar (``float(x)``).
+- every dispatch pays a large fixed tunnel round-trip (~60 ms); per-op
+  cost must be measured as the *slope* between an in-jit loop of K ops
+  and one op, not as single-dispatch wall time.
+- run ONE measurement per process invocation when the tunnel is flaky:
+  a killed client can wedge the server for everyone afterwards.
+
+Usage: python scripts/tpu_microbench.py [name ...]
+Names: elementwise cumsum gather rowgather lexsort2 lexsort3 scatter
+       (default: all, sequentially).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+B, M = 64, 20480  # the per-shard shape of the north-star batch
+
+
+def _slope(f, args, iters=8):
+    """Per-op ms via in-jit chaining: (t_many - t_one) / (iters - 1)."""
+
+    @jax.jit
+    def many(*a):
+        def body(_, x):
+            return f(*x)
+
+        return lax.fori_loop(0, iters, body, a)
+
+    @jax.jit
+    def once(*a):
+        return f(*a)
+
+    float(jnp.sum(many(*args)[0]))  # compile + warm
+    float(jnp.sum(once(*args)[0]))
+    t0 = time.perf_counter()
+    float(jnp.sum(many(*args)[0]))
+    t_many = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(jnp.sum(once(*args)[0]))
+    t_one = time.perf_counter() - t0
+    return (t_many - t_one) / (iters - 1) * 1e3, t_one * 1e3
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    val = jnp.asarray(rng.integers(0, 1 << 20, (B, M), dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, M, (B, M), dtype=np.int32))
+    return val, idx
+
+
+def bench_elementwise():
+    val, idx = _data()
+    return _slope(lambda v, i: ((v * i + 1) & 0xFFFFF, i), (val, idx))
+
+
+def bench_cumsum():
+    val, idx = _data()
+    return _slope(lambda v, i: (jnp.cumsum(v, axis=1) & 0xFFFFF, i),
+                  (val, idx))
+
+
+def bench_gather():
+    val, idx = _data()
+    return _slope(
+        lambda v, i: (jnp.take_along_axis(v, i, axis=1) & 0xFFFFF, i),
+        (val, idx),
+    )
+
+
+def bench_rowgather():
+    """Scalar gather as 128-wide row fetch + one-hot contraction: trades
+    128x data amplification for the TPU's fast row-gather path."""
+    val, idx = _data()
+
+    def f(v, i):
+        rows = v.reshape(B, M // 128, 128)
+        fetched = jnp.take_along_axis(
+            rows, (i >> 7)[:, :, None], axis=1
+        )  # [B, M, 128]
+        onehot = (
+            lax.broadcasted_iota(jnp.int32, (B, M, 128), 2)
+            == (i & 127)[:, :, None]
+        )
+        out = jnp.sum(fetched * onehot.astype(jnp.int32), axis=2)
+        return out & 0xFFFFF, i
+
+    return _slope(f, (val, idx))
+
+
+def bench_lexsort2():
+    val, idx = _data()
+    return _slope(
+        lambda v, i: (jnp.lexsort((i, v))[:, :1] + v[:, :1], i), (val, idx)
+    )
+
+
+def bench_lexsort3():
+    val, idx = _data()
+    return _slope(
+        lambda v, i: (jnp.lexsort((i, v, i))[:, :1] + v[:, :1], i),
+        (val, idx),
+    )
+
+
+def bench_scatter():
+    val, idx = _data()
+
+    def f(v, i):
+        out = jnp.zeros((B, M + 1), jnp.int32)
+        out = jax.vmap(lambda o, ii, vv: o.at[ii].set(vv))(out, i, v)
+        return out[:, :M], i
+
+    return _slope(f, (val, idx))
+
+
+ALL = {
+    "elementwise": bench_elementwise,
+    "cumsum": bench_cumsum,
+    "gather": bench_gather,
+    "rowgather": bench_rowgather,
+    "lexsort2": bench_lexsort2,
+    "lexsort3": bench_lexsort3,
+    "scatter": bench_scatter,
+}
+
+
+def main():
+    names = sys.argv[1:] or list(ALL)
+    print(f"devices: {jax.devices()}  shape: [{B}, {M}]")
+    for name in names:
+        per_op, once = ALL[name]()
+        per_m = per_op / (B * M / 1e6)
+        print(f"{name:12s}: {per_op:8.2f} ms/op  ({per_m:6.2f} ms/M-elem; "
+              f"single dispatch {once:.1f} ms)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,7 @@ def test_sharded_merge_matches_pure():
     cap = 16
     mesh = make_mesh()
     pairs, lanes, _metas = build_batch(rng, B, cap, n_edits=4)
-    order, rank, visible, digest, total_visible, n_conflicts = (
+    order, rank, visible, digest, total_visible, n_conflicts, n_overflow = (
         sharded_merge_weave(
             mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
             lanes["vc"], lanes["valid"],
@@ -48,6 +48,17 @@ def test_sharded_merge_matches_pure():
     )
     order, rank, visible = map(np.asarray, (order, rank, visible))
     assert int(n_conflicts) == 0
+    assert int(n_overflow) == 0
+    # the v2 (chain-compressed) sharded kernel agrees end to end
+    o2, r2, v2, d2, tv2, nc2, no2 = sharded_merge_weave(
+        mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
+        lanes["vc"], lanes["valid"], k_max=2 * cap,
+    )
+    assert int(no2) == 0 and int(nc2) == 0
+    assert np.array_equal(np.asarray(r2), rank)
+    assert np.array_equal(np.asarray(v2), visible)
+    assert np.array_equal(np.asarray(d2), np.asarray(digest))
+    assert int(tv2) == int(total_visible)
     expect_total = 0
     for bidx, (a_ct, b_ct) in enumerate(pairs):
         pure = s.merge_trees(c_list.weave, a_ct, b_ct)
@@ -84,7 +95,7 @@ def test_digests_detect_convergence():
         "valid": np.concatenate([na.valid, nb.valid]),
     }
     lanes = {k: np.stack([v] * B) for k, v in row.items()}
-    *_, digest, _total, _conf = sharded_merge_weave(
+    *_, digest, _total, _conf, _ovf = sharded_merge_weave(
         mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
         lanes["vc"], lanes["valid"],
     )
@@ -123,7 +134,7 @@ def test_digest_invariant_to_input_overlap():
         k: np.stack([rows[0][k]] * (B // 2) + [rows[1][k]] * (B - B // 2))
         for k in rows[0]
     }
-    *_, digest, _total, n_conflicts = sharded_merge_weave(
+    *_, digest, _total, n_conflicts, _ovf = sharded_merge_weave(
         mesh, lanes["hi"], lanes["lo"], lanes["chi"], lanes["clo"],
         lanes["vc"], lanes["valid"],
     )
