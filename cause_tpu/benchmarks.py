@@ -174,9 +174,14 @@ def config4_rich_text_base(weaver: str, paragraphs: int = 8,
 def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
                           n_base: int = 800, n_div: int = 100,
                           cap: int = 1024, reps: int = 3,
+                          k_max: Optional[int] = None,
                           profile_dir: Optional[str] = None) -> dict:
     """Batched device merge of divergent replicas (north-star shape;
-    sizes here are CLI defaults — bench.py runs the full 1024x10k)."""
+    sizes here are CLI defaults — bench.py runs the full 1024x10k).
+    ``k_max``: None = workload-derived run budget (the compressed v2
+    kernel), 0 = the uncompressed v1 kernel."""
+    import numpy as _np
+
     import jax
 
     from .benchgen import LANE_KEYS, merge_wave_scalar
@@ -186,15 +191,14 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
         capacity=cap, hide_every=8,
     )
     args = [jax.device_put(batch[k]) for k in LANE_KEYS]
-    k_max = benchgen.pair_run_budget(n_div)
+    if k_max is None:
+        k_max = benchgen.pair_run_budget(n_div)
 
     def step():
-        import numpy as _np
-
         out = _np.asarray(merge_wave_scalar(*args, k_max=k_max))
-        if out[1]:
+        if k_max and out.shape and out[1]:
             raise RuntimeError("run budget overflow — raise k_max")
-        return out[0]
+        return out
 
     step()  # compile + warm
     ctx = (
@@ -208,7 +212,7 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
         "config": 5,
         "metric": f"batched merge, {n_replicas} pairs x "
                   f"{1 + n_base + n_div}-node lists",
-        "weaver": "jax",
+        "weaver": "jax" if k_max else "jax-v1",
         "value": round(secs * 1000.0, 3),
         "unit": "ms",
     }

@@ -1,8 +1,9 @@
 """Compare the v1 and v2 merge+weave kernels at configurable scales.
 
-Run with a small batch first; the tunnel wedges if a huge program is
-killed mid-flight. Timing uses the checksum-transfer sync (see
-cause_tpu.benchgen.merge_wave_scalar).
+Thin wrapper over benchmarks.config5_batched_merge (the one shared
+timing harness — checksum-transfer sync, overflow abort). Run with a
+small batch first; the tunnel wedges if a huge program is killed
+mid-flight.
 
 Usage: python scripts/tpu_kernel_bench.py [B] [n_base] [n_div] [reps]
 Defaults: 64 9000 1000 3  (one-sixteenth of the north-star batch).
@@ -10,15 +11,13 @@ Defaults: 64 9000 1000 3  (one-sixteenth of the north-star batch).
 
 from __future__ import annotations
 
+import json
 import sys
 import time
 
-import numpy as np
-
 import jax
 
-from cause_tpu import benchgen
-from cause_tpu.benchgen import LANE_KEYS, merge_wave_scalar, pair_run_budget
+from cause_tpu.benchmarks import config5_batched_merge
 
 
 def main():
@@ -31,28 +30,18 @@ def main():
     print(f"B={B} nodes/tree={1 + n_base + n_div} cap={cap} "
           f"devices={jax.devices()}", flush=True)
 
-    batch = benchgen.batched_pair_lanes(
-        n_replicas=B, n_base=n_base, n_div=n_div, capacity=cap, hide_every=8
-    )
-    args = [jax.device_put(batch[k]) for k in LANE_KEYS]
-
-    for label, k_max in (("v1", 0), ("v2", pair_run_budget(n_div))):
+    for label, k_max in (("v1", 0), ("v2", None)):
         t0 = time.perf_counter()
-        out = np.asarray(merge_wave_scalar(*args, k_max=k_max))
-        print(f"{label}: compile+first {time.perf_counter() - t0:.1f}s",
+        rec = config5_batched_merge(
+            n_replicas=B, n_base=n_base, n_div=n_div, cap=cap, reps=reps,
+            k_max=k_max,
+        )
+        wall = time.perf_counter() - t0
+        per_pair = rec["value"] / B
+        print(f"{label}: {json.dumps(rec)}  "
+              f"({per_pair:.3f} ms/pair; x1024 projects to "
+              f"{per_pair * 1024:.0f} ms; incl compile {wall:.1f}s)",
               flush=True)
-        if k_max and out[1]:
-            print(f"{label}: OVERFLOW ({int(out[1])} rows)", flush=True)
-            continue
-        times = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            np.asarray(merge_wave_scalar(*args, k_max=k_max))
-            times.append((time.perf_counter() - t0) * 1e3)
-        p50 = float(np.median(times))
-        per_pair = p50 / B
-        print(f"{label}: p50 {p50:.1f} ms  ({per_pair:.3f} ms/pair; "
-              f"x1024 projects to {per_pair * 1024:.0f} ms)", flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,115 @@
+"""Property-based spec checks — the reference's generative layer
+(reference: test/causal/collections/shared_test.cljc:8-9 runs
+stest/check over the new-node fdef with test.check generators defined
+at shared.cljc:27-38). Here: hypothesis strategies for ids/values/nodes
+plus whole-tree invariant properties over random API interactions."""
+
+import string
+
+from hypothesis import assume, given, settings, strategies as st
+import pytest
+
+import cause_tpu as c
+from cause_tpu import spec
+from cause_tpu.collections import clist as c_list
+from cause_tpu.ids import K, SITE_ID_LENGTH, node
+
+ALPHABET = string.digits + string.ascii_letters + "_"
+
+site_ids = st.text(ALPHABET, min_size=SITE_ID_LENGTH,
+                   max_size=SITE_ID_LENGTH)
+lamports = st.integers(min_value=0, max_value=2**31 - 2)
+tx_indexes = st.integers(min_value=0, max_value=2**13 - 1)
+ids = st.tuples(lamports, site_ids, tx_indexes)
+specials = st.sampled_from([c.hide, c.h_hide, c.h_show])
+scalars = st.one_of(
+    st.none(), st.booleans(), st.integers(), st.text(max_size=5),
+    specials, st.builds(K, st.text(ALPHABET, min_size=1, max_size=8)),
+)
+
+
+@given(lamports, site_ids, tx_indexes, ids, scalars)
+def test_node_constructor_spec(ts, site, tx, cause, value):
+    """The new-node fdef: constructor output is a valid node whose id
+    is never its own cause (shared.cljc:85-98)."""
+    assume(tuple(cause) != (ts, site, tx))
+    n = node(ts, site, tx, tuple(cause), value)
+    assert spec.valid_node(n)
+    assert n[0] != n[1]
+
+
+def test_node_rejects_self_cause():
+    with pytest.raises(ValueError):
+        node(1, "siteA________", 0, (1, "siteA________", 0), "v")
+
+
+@given(ids)
+def test_id_spec(i):
+    assert spec.valid_id(tuple(i))
+    assert spec.valid_tx_id(tuple(i)[:2])
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.lists(st.tuples(st.sampled_from("ach"), scalars), max_size=12),
+       st.integers(0, 3))
+def test_list_interactions_keep_tree_valid(ops, n_sites):
+    """Random conj/append/hide interactions across sites preserve every
+    tree invariant, and the tree round-trips through serde."""
+    from cause_tpu.ids import new_site_id
+
+    sites = [new_site_id() for _ in range(n_sites)]
+    cl = c.clist()
+    for kind, value in ops:
+        if kind == "a":
+            cl = cl.conj(value)
+        elif kind == "c":
+            cl = cl.cons(value)
+        else:
+            nodes = cl.get_weave()
+            target = nodes[len(nodes) // 2][0]
+            site = sites[0] if sites else cl.get_site_id()
+            cl = cl.insert(((cl.get_ts() + 1, site, 0), target, c.hide))
+    assert spec.validate_tree(cl.ct), spec.explain_tree(cl.ct)
+    back = c.loads(c.dumps(cl))
+    assert spec.validate_tree(back.ct)
+    assert back.ct == cl.ct
+
+
+@settings(max_examples=30, deadline=None)
+@given(st.lists(st.tuples(scalars, scalars), max_size=10))
+def test_map_interactions_keep_tree_valid(kvs):
+    cm = c.cmap()
+    for k, v in kvs:
+        try:
+            hash(k)
+        except TypeError:
+            continue
+        cm = cm.append(k, v)
+    assert spec.validate_tree(cm.ct), spec.explain_tree(cm.ct)
+
+
+def test_explain_flags_corruption():
+    cl = c.clist(*"abc")
+    ct = cl.ct
+    # drop a mid-chain node from the store only
+    victim = sorted(ct.nodes)[2]
+    broken = ct.evolve(nodes={k: v for k, v in ct.nodes.items()
+                              if k != victim})
+    problems = spec.explain_tree(broken)
+    assert problems, "corrupted tree must not validate"
+    # clock behind a node
+    behind = ct.evolve(lamport_ts=0)
+    assert spec.explain_tree(behind)
+    # weave not a permutation
+    scrambled = ct.evolve(weave=ct.weave[:-1])
+    assert spec.explain_tree(scrambled)
+
+
+def test_merge_preserves_validity():
+    from cause_tpu.ids import new_site_id
+
+    base = c.clist(*"xy")
+    a = c_list.CausalList(base.ct.evolve(site_id=new_site_id())).conj("A")
+    b = c_list.CausalList(base.ct.evolve(site_id=new_site_id())).conj("B")
+    m = c.merge(a, b)
+    assert spec.validate_tree(m.ct), spec.explain_tree(m.ct)
