@@ -67,6 +67,10 @@ def weave(ct: CausalTree, node=None, more_nodes=None) -> CausalTree:
             from ..weaver import nativew
 
             return nativew.refresh_map_weave(ct)
+        if ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return jaxw.refresh_map_weave(ct)
         ct = ct.evolve(weave={})
         for nid in sorted(ct.nodes):
             ct = weave(ct, node_from_kv((nid, ct.nodes[nid])))

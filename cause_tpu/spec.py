@@ -22,10 +22,10 @@ encodes in prose and specs:
 
 from __future__ import annotations
 
-from typing import Any, List
+from typing import List
 
 from .collections import shared as s
-from .ids import ROOT_ID, ROOT_NODE, SITE_ID_LENGTH, is_id, is_key, is_special
+from .ids import ROOT_ID, ROOT_NODE, SITE_ID_LENGTH, is_id, is_key
 
 __all__ = [
     "valid_site_id",
@@ -143,6 +143,12 @@ def explain_tree(ct) -> List[str]:
                 problems.append("list weave is not a permutation of the store")
             elif ct.weave and ct.weave[0] != ROOT_NODE:
                 problems.append("list weave does not start at the root")
+            else:
+                for n in ct.weave[1:]:
+                    if ct.nodes.get(n[0]) != (n[1], n[2]):
+                        problems.append(
+                            f"weave node {n[0]!r} disagrees with the store"
+                        )
     else:
         if not isinstance(ct.weave, dict):
             problems.append("map weave is not a dict of key-weaves")
@@ -153,6 +159,14 @@ def explain_tree(ct) -> List[str]:
                     problems.append(f"key-weave {k!r} missing its root")
                     continue
                 woven.extend(n[0] for n in kw[1:])
+                for n in kw[1:]:
+                    body = ct.nodes.get(n[0])
+                    # in-weave causes are rewritten to the root for
+                    # key-caused nodes (map.cljc:77); values must agree
+                    if body is None or body[1] != n[2]:
+                        problems.append(
+                            f"key-weave node {n[0]!r} disagrees with the store"
+                        )
             if sorted(woven) != sorted(ct.nodes):
                 problems.append("map weave does not partition the store")
 

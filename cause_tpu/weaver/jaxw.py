@@ -324,6 +324,110 @@ def linearize_v2(hi, lo, cause_idx, vclass, valid, k_max: int):
 _linearize_v2_jit = jax.jit(linearize_v2, static_argnames="k_max")
 
 
+def linearize_map_forest(cause_idx, key_rank, vclass, valid, n_keys,
+                         k_cap: int):
+    """Map-weave ordering on device: one forest preorder over per-key
+    mini list-weaves (map.cljc:21-45).
+
+    Lanes are the real nodes in ascending id order; ``k_cap`` static
+    slots of virtual key roots (lane N+k is key k's ROOT sentinel,
+    ``n_keys`` of them live) are appended internally. Key-caused lanes
+    hang off their key's root; id-caused lanes off their target — then
+    the standard T* derivation applies per component.
+
+    Returns ``s_down`` ([N], int32): the tour suffix weight of each real
+    lane. Within one key's component s_down strictly decreases along
+    weave order, so the host orders each key's nodes by descending
+    s_down; cross-component offsets are irrelevant because the weave is
+    a per-key dict.
+    """
+    N = cause_idx.shape[0]
+    M = N + k_cap
+    idx = jnp.arange(M, dtype=jnp.int32)
+    is_rootlane = idx >= N
+    valid_all = jnp.concatenate(
+        [valid, jnp.arange(k_cap, dtype=jnp.int32) < n_keys]
+    )
+    special = jnp.concatenate([valid & (vclass > 0), jnp.zeros(k_cap, bool)])
+    cause_all = jnp.concatenate(
+        [
+            jnp.where(key_rank >= 0, N + key_rank,
+                      jnp.clip(cause_idx, 0, N - 1)),
+            jnp.arange(N, M, dtype=jnp.int32),  # roots cause themselves
+        ]
+    )
+    rel = valid_all & ~is_rootlane
+    host = _host_jump(special, cause_all, rel,
+                      max(1, math.ceil(math.log2(M))))
+    parent_t = jnp.where(special, cause_all, host)
+    parent_sort = jnp.where(rel, parent_t, M).astype(jnp.int32)
+    # sibling order: specials first, then descending id == descending
+    # lane (real lanes are id-sorted; roots are parentless)
+    packed = parent_sort * 2 + (~special).astype(jnp.int32)
+    order = jnp.lexsort((-idx, packed))
+    fc, ns = _link_children(order, parent_sort)
+    parent_up = jnp.where(rel, parent_t, -1)
+    weights = jnp.where(valid_all & ~is_rootlane, 1, 0).astype(jnp.int32)
+    _rank, _size = _euler_rank(fc, ns, parent_up, weights)
+    # recover per-lane suffix weight: _euler_rank's rank = total - s_down
+    total = jnp.sum(weights)
+    s_down = total - _rank
+    return s_down[:N]
+
+
+_linearize_map_jit = jax.jit(linearize_map_forest, static_argnames="k_cap")
+
+
+def refresh_map_weave(ct):
+    """Full map-weave rebuild on device (the ``weaver="jax"`` path of
+    cmap.weave): marshal with the shared map_lanes, rank the forest on
+    device, and split the order back into the per-key weave dict —
+    identical to the pure per-key replay (falls back to it off-domain).
+    """
+    from ..collections import cmap as c_map
+    from .arrays import OutsideDomain, next_pow2, rebuild_map_weave
+
+    try:
+        nodes, cause_idx, key_rank, vclass, valid_n, keys = _padded_map_lanes(
+            ct.nodes
+        )
+    except OutsideDomain:
+        return c_map.weave(ct.evolve(weaver="pure")).evolve(weaver=ct.weaver)
+    if not nodes:
+        return ct.evolve(weave={})
+    k_cap = next_pow2(max(1, len(keys)))
+    s_down = np.asarray(
+        _linearize_map_jit(
+            jnp.asarray(cause_idx), jnp.asarray(key_rank),
+            jnp.asarray(vclass), jnp.asarray(valid_n), len(keys),
+            k_cap=k_cap,
+        )
+    )
+    n = len(nodes)
+    # resolve each lane's key ordinal host-side (single-level rule)
+    key_of = np.where(key_rank[:n] >= 0, key_rank[:n], -1)
+    for i in range(n):
+        if key_of[i] < 0:
+            key_of[i] = key_of[cause_idx[i]]
+    order = sorted(range(n), key=lambda i: (key_of[i], -s_down[i]))
+    return ct.evolve(weave=rebuild_map_weave(nodes, key_of, order, keys))
+
+
+def _padded_map_lanes(nodes_map):
+    """map_lanes padded to a power-of-two capacity with a valid mask."""
+    from .arrays import map_lanes, next_pow2
+
+    nodes, cause_idx, key_rank, vclass, keys = map_lanes(nodes_map)
+    n = len(nodes)
+    cap = next_pow2(max(1, n))
+    pad = cap - n
+    cause_idx = np.concatenate([cause_idx, np.full(pad, -1, np.int32)])
+    key_rank = np.concatenate([key_rank, np.full(pad, -1, np.int32)])
+    vclass = np.concatenate([vclass, np.zeros(pad, np.int32)])
+    valid = np.concatenate([np.ones(n, bool), np.zeros(pad, bool)])
+    return nodes, cause_idx, key_rank, vclass, valid, keys
+
+
 def estimate_runs(cause_idx, vclass, valid) -> int:
     """Host-side (numpy) count of the chain-contracted tree's runs —
     the same contraction ``linearize_v2`` performs, so the device

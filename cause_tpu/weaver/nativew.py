@@ -18,12 +18,12 @@ speed.
 
 from __future__ import annotations
 
-from typing import Dict, List, Tuple
+from typing import Tuple
 
 import numpy as np
 
 from .. import native
-from ..ids import ROOT_ID, ROOT_NODE, is_id
+from .arrays import OutsideDomain as _OutsideDomain
 
 __all__ = [
     "available",
@@ -35,10 +35,6 @@ __all__ = [
 
 def available() -> bool:
     return native.available()
-
-
-class _OutsideDomain(Exception):
-    pass
 
 
 def _list_lanes(nodes_map) -> Tuple[list, np.ndarray, np.ndarray]:
@@ -75,67 +71,23 @@ def refresh_list_weave(ct):
     return ct.evolve(weave=[nodes[i] for i in order])
 
 
-def _map_lanes(nodes_map):
-    """(sorted_nodes, cause_idx, key_rank, vclass, keys) for a map tree.
-
-    Key resolution follows the pure weaver exactly (single level:
-    an id-caused node's key is its target's cause, map.cljc:31-37), so
-    the native domain requires id-caused nodes to target key-caused
-    nodes — everything the collection/base APIs generate.
-    """
-    from .arrays import vclass_of
-
-    ids = sorted(nodes_map)
-    idx_of = {nid: i for i, nid in enumerate(ids)}
-    n = len(ids)
-    cause_idx = np.full(n, -1, np.int32)
-    key_rank = np.full(n, -1, np.int32)
-    vclass = np.zeros(n, np.int32)
-    keys: List = []
-    key_ordinal: Dict = {}
-    nodes = []
-    for i, nid in enumerate(ids):
-        cause, value = nodes_map[nid]
-        vclass[i] = vclass_of(value)
-        if is_id(cause):
-            ci = idx_of.get(tuple(cause), -1)
-            if ci < 0:
-                raise _OutsideDomain()  # dangling target
-            target_cause = nodes_map[tuple(cause)][0]
-            if is_id(target_cause):
-                raise _OutsideDomain()  # id-caused targeting id-caused
-            cause_idx[i] = ci
-        else:
-            k = cause
-            if k not in key_ordinal:
-                key_ordinal[k] = len(keys)
-                keys.append(k)
-            key_rank[i] = key_ordinal[k]
-        nodes.append((nid, cause, value))
-    return nodes, cause_idx, key_rank, vclass, keys
-
-
 def refresh_map_weave(ct):
     """Full map-weave rebuild through the native linearizer: one forest
     preorder, split into the per-key weave dict (identical to the pure
     per-key replay; falls back off-domain)."""
     from ..collections import cmap as c_map
 
+    from .arrays import map_lanes, rebuild_map_weave
+
     try:
-        nodes, cause_idx, key_rank, vclass, keys = _map_lanes(ct.nodes)
+        nodes, cause_idx, key_rank, vclass, keys = map_lanes(ct.nodes)
         rank, key_out = native.weave_map_ranks(
             cause_idx, key_rank, vclass, len(keys)
         )
     except (RuntimeError, _OutsideDomain):
         return c_map.weave(ct.evolve(weaver="pure")).evolve(weaver=ct.weaver)
     order = _inverse_permutation(rank)
-    weave: Dict = {}
-    for i in order:
-        nid, cause, value = nodes[i]
-        k = keys[key_out[i]]
-        in_weave_cause = cause if is_id(cause) else ROOT_ID
-        weave.setdefault(k, [ROOT_NODE]).append((nid, in_weave_cause, value))
-    return ct.evolve(weave=weave)
+    return ct.evolve(weave=rebuild_map_weave(nodes, key_out, order, keys))
 
 
 def refresh_weave(ct):

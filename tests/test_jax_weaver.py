@@ -193,6 +193,48 @@ def test_linearize_v2_parity():
         assert np.array_equal(np.asarray(v1), np.asarray(v2)), f"tree {ti}"
 
 
+def test_jax_map_weave_parity():
+    """The device map forest ranking reproduces the pure per-key replay
+    across LWW overwrites, id-caused undo, and random churn."""
+    from cause_tpu.collections import cmap as c_map
+    from cause_tpu.ids import K
+    from cause_tpu.weaver import jaxw
+
+    def pure_map_weave(ct):
+        return c_map.weave(ct.evolve(weaver="pure")).weave
+
+    cm = c.cmap().assoc(K("a"), 1).assoc(K("b"), 2).assoc(K("a"), 3)
+    cm = cm.dissoc(K("b"))
+    overwrite_id = list(cm)[0][0]
+    cm = cm.append(overwrite_id, c.h_hide).append(overwrite_id, c.h_show)
+    assert jaxw.refresh_map_weave(cm.ct).weave == pure_map_weave(cm.ct)
+
+    from test_map import rand_map_node
+
+    rng = random.Random(0xAB)
+    for round_ in range(25):
+        sites = [new_site_id() for _ in range(3)]
+        cm = c.cmap()
+        for _ in range(rng.randrange(1, 14)):
+            cm = cm.insert(rand_map_node(rng, cm, rng.choice(sites)))
+        got = jaxw.refresh_map_weave(cm.ct).weave
+        assert got == pure_map_weave(cm.ct), (
+            f"divergence in round {round_}: nodes={sorted(cm.ct.nodes)}"
+        )
+
+
+def test_jax_map_end_to_end():
+    """weaver="jax" maps behave identically through the public API,
+    including refresh_caches and empty maps."""
+    from cause_tpu.collections import cmap as c_map
+    from cause_tpu.ids import K
+
+    cm = c.cmap(weaver="jax").assoc(K("x"), 1).assoc(K("y"), 2)
+    refreshed = s.refresh_caches(c_map.weave, cm.ct)
+    assert refreshed.weave == cm.ct.weave
+    assert c.cmap(weaver="jax").causal_to_edn() == {}
+
+
 def test_linearize_v2_overflow_flag():
     """A run budget below the real run count must raise the flag."""
     import jax.numpy as jnp

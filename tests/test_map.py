@@ -3,6 +3,26 @@
 import pytest
 
 import cause_tpu as c
+
+
+def rand_map_node(rng, cm, site_id):
+    """A random map node: key- or id-caused, special or plain value in
+    every combination (the shared fuzz generator of the map parity
+    suites)."""
+    from cause_tpu.ids import K
+
+    keys = [K("a"), K("b"), "plain", 7]
+    ts = cm.get_ts() + 1
+    value = (
+        rng.choice([c.hide, c.h_hide, c.h_show])
+        if rng.random() < 0.4
+        else rng.randrange(100)
+    )
+    if rng.random() < 0.4 and len(cm.ct.nodes) > 0:
+        cause = rng.choice(sorted(cm.ct.nodes))  # id-caused
+    else:
+        cause = rng.choice(keys)  # key-caused
+    return ((ts, site_id, 0), cause, value)
 from cause_tpu.ids import ROOT_ID
 
 

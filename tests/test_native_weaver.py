@@ -71,22 +71,14 @@ def test_map_parity_id_caused_undo():
 
 
 def test_map_fuzz_parity():
+    from test_map import rand_map_node
+
     rng = random.Random(0xFACADE)
-    keys = [K("a"), K("b"), "plain", 7]
     for round_ in range(60):
         sites = [new_site_id() for _ in range(3)]
         cm = c.cmap()
         for _ in range(rng.randrange(1, 15)):
-            site = rng.choice(sites)
-            ts = cm.get_ts() + 1
-            if rng.random() < 0.3 and len(cm.ct.nodes) > 0:
-                # id-caused hide/show targeting a random existing node
-                target = rng.choice(sorted(cm.ct.nodes))
-                val = rng.choice([c.hide, c.h_hide, c.h_show])
-                n = ((ts, site, 0), target, val)
-            else:
-                n = ((ts, site, 0), rng.choice(keys), rng.randrange(100))
-            cm = cm.insert(n)
+            cm = cm.insert(rand_map_node(rng, cm, rng.choice(sites)))
         nat = nativew.refresh_map_weave(cm.ct).weave
         assert nat == pure_map_weave(cm.ct), (
             f"divergence in round {round_}: nodes={sorted(cm.ct.nodes)}"
