@@ -69,6 +69,25 @@ def config1_append_only(weaver: str, n: int = 1000, reps: int = 3) -> dict:
     }
 
 
+def config1_bulk_extend(weaver: str, n: int = 1000, reps: int = 3) -> dict:
+    """Config 1's paste variant: the same n chars as contiguous
+    transaction runs via extend — the O(n+m) path (README.md:50,229)."""
+    text = ("abcdefgh" * (n // 8 + 1))[:n]
+
+    def run():
+        return new_causal_list(weaver=weaver).extend(text)
+
+    secs, cl = _timed(run, reps)
+    assert len(cl) == n
+    return {
+        "config": 1,
+        "metric": f"bulk extend x{n}",
+        "weaver": weaver,
+        "value": round(n / secs, 1),
+        "unit": "nodes/sec",
+    }
+
+
 def config2_concurrent_hide(weaver: str, n_per_site: int = 120,
                             reps: int = 3) -> dict:
     """3 sites interleave inserts, hide every 5th node, then all three
@@ -261,6 +280,8 @@ def main(argv=None) -> None:
                                   "skipped": "native toolchain unavailable"}))
                 continue
             print(json.dumps(run_config(num, w)))
+            if num == 1:
+                print(json.dumps(config1_bulk_extend(w)))
 
 
 if __name__ == "__main__":

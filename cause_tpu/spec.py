@@ -162,8 +162,16 @@ def explain_tree(ct) -> List[str]:
                 for n in kw[1:]:
                     body = ct.nodes.get(n[0])
                     # in-weave causes are rewritten to the root for
-                    # key-caused nodes (map.cljc:77); values must agree
-                    if body is None or body[1] != n[2]:
+                    # key-caused nodes (map.cljc:77): a root-caused
+                    # entry must be filed under its store key, an
+                    # id-caused one must keep its store cause; values
+                    # must agree either way
+                    if (
+                        body is None
+                        or body[1] != n[2]
+                        or (n[1] == ROOT_ID and body[0] != k)
+                        or (n[1] != ROOT_ID and body[0] != n[1])
+                    ):
                         problems.append(
                             f"key-weave node {n[0]!r} disagrees with the store"
                         )

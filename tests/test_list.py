@@ -215,6 +215,36 @@ def test_hide_and_show_and_hide_and_show():
     assert cl.causal_to_edn() == ["a", "b", "c"]
 
 
+def test_extend_bulk_append():
+    """extend == conj'ing the same values (rendered state), as one tx
+    run per chunk, and the result passes the idempotency oracle."""
+    vals = list("hello world")
+    a = c.clist().extend(vals)
+    b = c.clist()
+    for v in vals:
+        b = b.conj(v)
+    assert a.causal_to_edn() == b.causal_to_edn() == vals
+    # one lamport tick for the whole run, tx-index orders within it
+    assert a.get_ts() == 1
+    ids = [n[0] for n in list(a)]
+    assert [i[2] for i in ids] == list(range(len(vals)))
+    assert_idempotent(a)
+    # appends after an extend keep working
+    assert a.conj("!").causal_to_edn() == vals + ["!"]
+    # chunking: runs longer than one tx's index space split cleanly
+    from cause_tpu.collections import clist as c_list
+
+    old = c_list.MAX_TX_RUN
+    c_list.MAX_TX_RUN = 4
+    try:
+        chunked = c.clist().extend("abcdefghij")
+        assert chunked.causal_to_edn() == list("abcdefghij")
+        assert chunked.get_ts() == 3  # 3 runs of <=4
+        assert_idempotent(chunked)
+    finally:
+        c_list.MAX_TX_RUN = old
+
+
 def test_core_list_protocol():
     """(list_test.cljc:175-202) — len counts active values; iteration
     yields visible nodes."""
