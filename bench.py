@@ -1,40 +1,84 @@
 """North-star benchmark: batched merge of divergent 10k-node CausalLists
 across 1024 replica pairs on one chip (BASELINE.json config 5).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} where
-value is the p50 wall latency of the full batched merge+weave program
-(union, cause resolution, linearization, visibility) and vs_baseline is
-the north-star target (100 ms) divided by the measured p50 — >1.0 means
-the target is beaten.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (plus a
+"platform" tag) where value is the p50 wall latency of the full batched
+merge+weave program (union, cause resolution, linearization, visibility)
+and vs_baseline is the north-star target (100 ms) divided by the
+measured p50 — >1.0 means the target is beaten.
+
+Robustness contract (round 1 shipped rc=1 and zero numbers when the
+axon TPU backend failed to initialize — never again): every measurement
+runs in a *child process* under a timeout, so a backend that raises OR
+wedges can't take the bench down; on failure the parent retries on CPU
+at smoke size with an honest ``"platform": "cpu-fallback"`` tag and a
+``vs_baseline`` of 0 (the 100 ms target is defined at full size on
+TPU). Any outcome still prints a parseable JSON line and exits 0.
 
 Timing note: on the axon-tunneled TPU, ``jax.block_until_ready`` does
 not actually block, so the timed program reduces its outputs to one
 scalar and the harness forces a device->host transfer of that scalar —
 the only reliable sync point. The reduction cost is noise next to the
 merge itself.
-
-Run on whatever jax.devices() offers (TPU under the driver; CPU works
-for smoke tests via BENCH_SMOKE=1).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
-import numpy as np
-
-import jax
-
-from cause_tpu import benchgen
-from cause_tpu.benchgen import LANE_KEYS, merge_wave_scalar
-
 NORTH_STAR_MS = 100.0
+# generous: first XLA compile of the 1024x10k kernel + 4 timed reps
+FULL_TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", "1500"))
+CPU_TIMEOUT_S = 900.0
+# a wedged backend costs at most this before the CPU fallback engages
+PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
 
 
-def main() -> None:
-    smoke = os.environ.get("BENCH_SMOKE", "").strip() in ("1", "true", "yes")
+def backend_alive() -> bool:
+    """Quick child-process probe of the default backend, so a wedged
+    TPU tunnel costs PROBE_TIMEOUT_S — not FULL_TIMEOUT_S — before the
+    bench falls back to CPU."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except (subprocess.TimeoutExpired, OSError):
+        print("bench: backend probe wedged; skipping TPU attempt",
+              file=sys.stderr)
+        return False
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
+        print(f"bench: backend probe failed ({tail[0][:200]})",
+              file=sys.stderr)
+        return False
+    return True
+
+
+class _Overflow(RuntimeError):
+    pass
+
+
+def measure(platform: str) -> dict:
+    import numpy as np
+
+    import jax
+
+    if platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS, merge_wave_scalar
+
+    real_platform = jax.devices()[0].platform
+    smoke = (
+        real_platform == "cpu"
+        or os.environ.get("BENCH_SMOKE", "").strip() in ("1", "true", "yes")
+    )
     if smoke:
         B, n_base, n_div, cap, reps = 8, 800, 100, 1024, 3
     else:
@@ -47,28 +91,99 @@ def main() -> None:
     )
     args = [jax.device_put(batch[k]) for k in LANE_KEYS]
 
-    k_max = benchgen.pair_run_budget(n_div)
+    k_max = benchgen.pair_run_budget(batch)
 
-    def step() -> None:
+    def step(k: int) -> None:
         # one transfer fetches checksum + overflow and forces execution
-        out = np.asarray(merge_wave_scalar(*args, k_max=k_max))
-        if out[1]:  # overflowed rows carry garbage ranks
-            raise SystemExit("run budget overflow — raise k_max")
+        out = np.asarray(merge_wave_scalar(*args, k_max=k))
+        if k and out[1]:  # overflowed rows carry garbage ranks
+            raise _Overflow()
 
-    step()  # compile + warmup
+    # compile + warmup; an unsampled row blowing the sampled run budget
+    # is recoverable — raise it, then fall back to the uncompressed
+    # kernel (k_max=0, which cannot overflow) before giving up
+    for k_max in (k_max, 2 * k_max, 0):
+        try:
+            step(k_max)
+            break
+        except _Overflow:
+            print(f"bench: run budget {k_max} overflowed; retrying",
+                  file=sys.stderr)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        step()
+        step(k_max)
         times.append((time.perf_counter() - t0) * 1000.0)
     p50 = float(np.median(times))
 
-    print(json.dumps({
+    tag = os.environ.get("BENCH_TAG") or real_platform
+    # the 100 ms target is defined at full size on TPU; a smoke-size
+    # run must not claim to beat it
+    vs = round(NORTH_STAR_MS / p50, 3) if not smoke else 0.0
+    return {
         "metric": f"p50 batched merge+weave, {B} replica pairs x "
-                  f"{1 + n_base + n_div}-node CausalLists",
+                  f"{1 + n_base + n_div}-node CausalLists"
+                  + (" [smoke size]" if smoke else ""),
         "value": round(p50, 3),
         "unit": "ms",
-        "vs_baseline": round(NORTH_STAR_MS / p50, 3),
+        "vs_baseline": vs,
+        "platform": tag,
+    }
+
+
+def main() -> None:
+    child_platform = os.environ.get("BENCH_EXEC", "")
+    if child_platform:
+        # child mode: measure on the named platform, print, let any
+        # failure propagate — the parent handles it
+        print(json.dumps(measure(child_platform)))
+        return
+
+    force_cpu = os.environ.get("BENCH_FORCE_CPU", "").strip() in (
+        "1", "true", "yes"
+    )
+    # an explicitly requested CPU run is "cpu-forced"; "cpu-fallback"
+    # only when a TPU attempt actually failed first
+    if force_cpu:
+        attempts = [("cpu", CPU_TIMEOUT_S, "cpu-forced")]
+    elif backend_alive():
+        attempts = [("default", FULL_TIMEOUT_S, ""),
+                    ("cpu", CPU_TIMEOUT_S, "cpu-fallback")]
+    else:
+        attempts = [("cpu", CPU_TIMEOUT_S, "cpu-fallback")]
+
+    errors = []
+    for platform, timeout, tag in attempts:
+        env = dict(os.environ, BENCH_EXEC=platform, BENCH_TAG=tag)
+        try:
+            r = subprocess.run(
+                [sys.executable, __file__], env=env,
+                capture_output=True, text=True, timeout=timeout,
+            )
+        except (subprocess.TimeoutExpired, OSError) as e:
+            errors.append(f"{platform}: {type(e).__name__}")
+            print(f"bench: {platform} attempt failed ({type(e).__name__}); "
+                  "retrying on CPU" if platform != "cpu" else
+                  f"bench: cpu attempt failed ({type(e).__name__})",
+                  file=sys.stderr)
+            continue
+        out = r.stdout.strip()
+        if r.returncode == 0 and out:
+            print(out.splitlines()[-1])
+            return
+        tail = (r.stderr or "").strip().splitlines()[-1:] or ["?"]
+        errors.append(f"{platform}: rc={r.returncode} {tail[0][:200]}")
+        print(f"bench: {platform} attempt rc={r.returncode}; "
+              + ("retrying on CPU" if platform != "cpu" else "giving up"),
+              file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "p50 batched merge+weave (all attempts failed)",
+        "value": None,
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "platform": "none",
+        "error": "; ".join(errors)[:500],
     }))
 
 

@@ -38,20 +38,70 @@ __all__ = [
     "chain_tree_lanes",
     "divergent_pair_lanes",
     "batched_pair_lanes",
+    "estimate_pair_runs",
+    "pair_run_budget",
     "merge_wave_scalar",
     "LANE_KEYS",
 ]
 
 LANE_KEYS = ("hi", "lo", "chi", "clo", "vc", "valid")
 
-def pair_run_budget(n_div: int) -> int:
-    """Chain-contracted run count bound for one ``divergent_pair_lanes``
-    merge. The base chain compresses to one run, but the two suffixes
-    interleave in id order (same ts range, different sites), so no
-    suffix node is kept-lane-adjacent to its cause and every suffix
-    node is its own run: runs ~= 2*n_div + small constants. Measured:
-    201 runs for n_div=100."""
-    return 2 * n_div + 64
+def _union_lanes_np(hi, lo, chi, clo, vc, valid):
+    """Numpy twin of the merge kernel's front half (id lexsort, dup
+    drop, sort-join cause resolution) — host-side, so run budgets can
+    be derived from the real post-union lane structure before any
+    device dispatch."""
+    order = np.lexsort((lo, hi))
+    hi_s, lo_s = hi[order], lo[order]
+    dup = np.concatenate(
+        [[False], (hi_s[1:] == hi_s[:-1]) & (lo_s[1:] == lo_s[:-1])]
+    )
+    keep = valid[order] & ~dup
+    vc_s = vc[order]
+    chi_s, clo_s = chi[order], clo[order]
+    kept_idx = np.flatnonzero(keep)
+    key = (
+        (hi_s[kept_idx].astype(np.int64) << 32)
+        | (lo_s[kept_idx].astype(np.int64) & 0xFFFFFFFF)
+    )
+    q = (
+        (chi_s.astype(np.int64) << 32)
+        | (clo_s.astype(np.int64) & 0xFFFFFFFF)
+    )
+    pos = np.searchsorted(key, q)
+    pos_c = np.clip(pos, 0, max(0, len(key) - 1))
+    found = (len(key) > 0) & (key[pos_c] == q)
+    cause_idx = np.where(found, kept_idx[pos_c], -1).astype(np.int32)
+    return cause_idx, vc_s, keep
+
+
+def estimate_pair_runs(row: Dict[str, np.ndarray]) -> int:
+    """Chain-contracted run count of one replica-pair merge, computed
+    host-side: emulate the union front half in numpy, then run the same
+    ``estimate_runs`` the API dispatch uses."""
+    from .weaver.jaxw import estimate_runs
+
+    cause_idx, vc_s, keep = _union_lanes_np(
+        row["hi"], row["lo"], row["chi"], row["clo"], row["vc"], row["valid"]
+    )
+    return estimate_runs(cause_idx, vc_s, keep)
+
+
+def pair_run_budget(batch: Dict[str, np.ndarray], sample_rows: int = 4) -> int:
+    """Run budget for the compressed (v2) kernel, *derived* from the
+    generated lanes instead of a shape-specific formula: the host run
+    estimator on sampled rows (all of them for a single row dict), plus
+    headroom for unsampled rows — the kernel's overflow flag still
+    backstops an underestimate."""
+    hi = batch["hi"]
+    if hi.ndim == 1:
+        rows = [batch]
+    else:
+        B = hi.shape[0]
+        picks = sorted({0, B // 3, (2 * B) // 3, B - 1})[:sample_rows]
+        rows = [{k: batch[k][i] for k in LANE_KEYS} for i in picks]
+    worst = max(estimate_pair_runs(r) for r in rows)
+    return int(worst + max(64, worst // 8))
 
 
 _scalar_programs: Dict = {}
@@ -172,11 +222,12 @@ def divergent_pair_lanes(
     n_div: int,
     capacity: int,
     hide_every: int = 0,
+    spec: PackSpec = DEFAULT_PACK,
 ) -> Dict[str, np.ndarray]:
     """Concatenated lanes ([2*capacity]) of one divergent replica pair —
     the per-replica input of ``merge_weave_kernel``."""
-    a = chain_tree_lanes(n_base, n_div, SITE_A, capacity, hide_every)
-    b = chain_tree_lanes(n_base, n_div, SITE_B, capacity, hide_every)
+    a = chain_tree_lanes(n_base, n_div, SITE_A, capacity, hide_every, spec)
+    b = chain_tree_lanes(n_base, n_div, SITE_B, capacity, hide_every, spec)
     return {k: np.concatenate([a[k], b[k]]) for k in a}
 
 
@@ -186,12 +237,49 @@ def batched_pair_lanes(
     n_div: int,
     capacity: int,
     hide_every: int = 0,
+    spec: PackSpec = DEFAULT_PACK,
 ) -> Dict[str, np.ndarray]:
     """The [B, 2*capacity] batch for ``batched_merge_weave`` /
-    ``sharded_merge_weave``: ``n_replicas`` divergent pairs. Rows are
-    identical in structure (XLA's work per row does not depend on lane
-    values), so the batch is a broadcast — cheap to build at B=1024."""
-    row = divergent_pair_lanes(n_base, n_div, capacity, hide_every)
-    return {
+    ``sharded_merge_weave``: ``n_replicas`` genuinely *distinct*
+    divergent pairs. Every row shares the base chain but gets its own
+    pair of suffix sites (row r: ranks ``SITE_A+2r`` / ``SITE_A+2r+1``)
+    and its own tombstone phase, so no two rows converge to the same
+    weave — per-row digests must differ (asserted by the driver
+    dryrun). Built as one broadcast plus vectorized per-row lane
+    rewrites, so B=1024 stays cheap."""
+    row = divergent_pair_lanes(n_base, n_div, capacity, hide_every, spec)
+    out = {
         k: np.broadcast_to(v, (n_replicas,) + v.shape).copy() for k, v in row.items()
     }
+    if n_replicas <= 1 or n_div == 0:
+        return out
+
+    r = np.arange(n_replicas, dtype=np.int32)
+    site_a = (SITE_A + 2 * r)[:, None].astype(np.int32)
+    site_b = site_a + 1
+    # max rank used is SITE_A + 2*n_replicas - 1; generator lanes have
+    # tx=0, so even a max-rank lo can't collide with the I32_MAX sentinel
+    n_sites = SITE_A + 2 * n_replicas
+    if n_sites > (1 << spec.site_bits):
+        raise OverflowError(f"{n_sites} sites exceed {spec.site_bits} bits")
+
+    # suffix id lanes (tx = 0 throughout the generator)
+    sfx_a = slice(1 + n_base, 1 + n_base + n_div)
+    sfx_b = slice(capacity + 1 + n_base, capacity + 1 + n_base + n_div)
+    out["lo"][:, sfx_a] = site_a << spec.tx_bits
+    out["lo"][:, sfx_b] = site_b << spec.tx_bits
+    # within-suffix chain causes (every suffix node but the first, whose
+    # cause is the base tail and keeps the base site)
+    csfx_a = slice(2 + n_base, 1 + n_base + n_div)
+    csfx_b = slice(capacity + 2 + n_base, capacity + 1 + n_base + n_div)
+    out["clo"][:, csfx_a] = site_a << spec.tx_bits
+    out["clo"][:, csfx_b] = site_b << spec.tx_bits
+
+    if hide_every > 0:
+        # per-row tombstone phase; sides get different phases too
+        j = np.arange(1, n_div + 1)
+        hide_a = ((j[None, :] + r[:, None]) % hide_every) == 0
+        hide_b = ((j[None, :] + r[:, None] + 1) % hide_every) == 0
+        out["vc"][:, sfx_a] = np.where(hide_a, VCLASS_HIDE, 0).astype(np.int32)
+        out["vc"][:, sfx_b] = np.where(hide_b, VCLASS_HIDE, 0).astype(np.int32)
+    return out

@@ -211,7 +211,7 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
     )
     args = [jax.device_put(batch[k]) for k in LANE_KEYS]
     if k_max is None:
-        k_max = benchgen.pair_run_budget(n_div)
+        k_max = benchgen.pair_run_budget(batch)
 
     def step():
         out = _np.asarray(merge_wave_scalar(*args, k_max=k_max))

@@ -75,12 +75,18 @@ class Ref:
     def causal_to_edn(self, opts: Optional[dict] = None):
         """Ref deref on render (the Keyword CausalTo extension,
         base/core.cljc:83-90). Without a base in opts the ref passes
-        through unchanged."""
+        through unchanged. Cyclic refs render as the unexpanded ref at
+        the point of recurrence instead of dying with RecursionError —
+        the reference leaves this as an open TODO (base/core.cljc:89)."""
         opts = opts or {}
         cb = opts.get("cb")
-        if cb is not None:
-            return s.causal_to_edn(get_collection_(cb, self), opts)
-        return self
+        if cb is None:
+            return self
+        stack = opts.get("_ref_stack", frozenset())
+        if self.uuid in stack:
+            return self  # cycle: stop expanding, keep the pointer
+        opts = dict(opts, _ref_stack=stack | {self.uuid})
+        return s.causal_to_edn(get_collection_(cb, self), opts)
 
 
 def uuid_to_ref(uuid: str) -> Ref:

@@ -225,6 +225,10 @@ class CausalMap:
         )
 
     def merge(self, other: "CausalMap") -> "CausalMap":
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return CausalMap(jaxw.merge_map_trees(self.ct, other.ct))
         if self.ct.weaver == "native":
             from ..weaver import nativew
 

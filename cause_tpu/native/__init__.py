@@ -15,6 +15,7 @@ import ctypes
 import os
 import subprocess
 import threading
+import warnings
 from typing import Optional
 
 import numpy as np
@@ -23,7 +24,9 @@ __all__ = ["available", "weave_list_ranks", "weave_map_ranks", "lib"]
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "weaver.cpp")
-_SO = os.path.join(_HERE, "_ct_weaver.so")
+# read-only installs can point the build cache elsewhere
+_CACHE_DIR = os.environ.get("CAUSE_TPU_NATIVE_CACHE", _HERE)
+_SO = os.path.join(_CACHE_DIR, "_ct_weaver.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -35,6 +38,7 @@ def _build() -> Optional[ctypes.CDLL]:
     compile goes to a per-pid temp file and is renamed into place so
     concurrent first-use across processes never loads a torn .so."""
     if not (os.path.exists(_SO) and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        os.makedirs(_CACHE_DIR, exist_ok=True)
         tmp = f"{_SO}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
         try:
@@ -61,8 +65,17 @@ def lib() -> Optional[ctypes.CDLL]:
             if _lib is None and not _build_failed:
                 try:
                     _lib = _build()
-                except (OSError, subprocess.CalledProcessError):
+                except (OSError, subprocess.CalledProcessError) as e:
                     _build_failed = True
+                    detail = getattr(e, "stderr", "") or str(e)
+                    warnings.warn(
+                        "cause_tpu native weaver build failed; "
+                        'weaver="native" degrades to the pure host path '
+                        "(set CAUSE_TPU_NATIVE_CACHE to a writable dir "
+                        f"if the install is read-only): {detail.strip()[:400]}",
+                        RuntimeWarning,
+                        stacklevel=3,
+                    )
     return _lib
 
 

@@ -21,6 +21,7 @@ Tag scheme (single-``~``-key JSON objects; plain scalars pass through):
 
 ====================  =========================================
 ``{"~k": name}``      Keyword
+``{"~f": name}``      non-finite float (``nan`` / ``inf`` / ``-inf``)
 ``{"~s": name}``      Special (``hide`` / ``h.hide`` / ``h.show``)
 ``{"~r": uuid}``      Ref to a nested collection
 ``{"~t": [...]}``     tuple
@@ -49,6 +50,8 @@ from .collections.shared import CausalTree
 from .ids import Keyword, Special, is_id
 
 __all__ = ["to_data", "from_data", "dumps", "loads"]
+
+_INF = float("inf")
 
 
 def _encode_id(nid) -> list:
@@ -142,7 +145,14 @@ def _decode_base(d: dict) -> CausalBase:
 
 
 def to_data(x) -> Any:
-    """Encode a value (causal or plain) to JSON-able tagged data."""
+    """Encode a value (causal or plain) to JSON-able tagged data.
+    Non-finite floats get a tag (``{"~f": "nan"|"inf"|"-inf"}``) so the
+    emitted JSON stays strict RFC 8259 — a bare NaN/Infinity literal
+    would be rejected by every non-Python parser."""
+    if isinstance(x, float) and x != x:
+        return {"~f": "nan"}
+    if isinstance(x, float) and (x == _INF or x == -_INF):
+        return {"~f": "inf" if x > 0 else "-inf"}
     if x is None or isinstance(x, (bool, int, float, str)):
         return x
     if isinstance(x, Keyword):
@@ -183,6 +193,8 @@ def from_data(d) -> Any:
     if isinstance(d, list):
         return [from_data(v) for v in d]
     if isinstance(d, dict):
+        if "~f" in d:
+            return {"nan": float("nan"), "inf": _INF, "-inf": -_INF}[d["~f"]]
         if "~k" in d:
             return Keyword(d["~k"])
         if "~s" in d:
@@ -206,8 +218,10 @@ def from_data(d) -> Any:
 
 
 def dumps(x, indent: Optional[int] = None) -> str:
-    """Serialize a causal collection / base / plain value to JSON text."""
-    return json.dumps(to_data(x), indent=indent)
+    """Serialize a causal collection / base / plain value to strict
+    RFC-compliant JSON text (non-finite floats are tagged by
+    ``to_data``, so ``allow_nan=False`` can never trip on them)."""
+    return json.dumps(to_data(x), indent=indent, allow_nan=False)
 
 
 def loads(text: str) -> Any:

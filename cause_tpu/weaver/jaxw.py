@@ -65,7 +65,9 @@ __all__ = [
     "estimate_runs",
     "weave_arrays",
     "refresh_list_weave",
+    "refresh_map_weave",
     "merge_list_trees",
+    "merge_map_trees",
     "merge_weave_kernel",
     "merge_weave_kernel_v2",
     "batched_merge_weave",
@@ -502,6 +504,15 @@ def merge_list_trees(ct1, ct2):
     from ..collections import shared as s
 
     return refresh_list_weave(s.union_nodes(ct1, ct2))
+
+
+def merge_map_trees(ct1, ct2):
+    """Device-backed map merge (map.cljc:248-249 semantics): union the
+    node stores host-side, then one device forest linearization over
+    the per-key mini-weaves — the map twin of ``merge_list_trees``."""
+    from ..collections import shared as s
+
+    return refresh_map_weave(s.union_nodes(ct1, ct2))
 
 
 # ------------------------- batched merge kernel -------------------------

@@ -19,6 +19,31 @@ def test_cb_to_edn():
     ]
 
 
+def test_cb_to_edn_cyclic_ref():
+    """A self-referential base renders with the ref left unexpanded at
+    the point of recurrence instead of RecursionError — beating the
+    reference's open TODO (base/core.cljc:89)."""
+    cb = b.transact_(b.new_cb(), [[None, None, {K("a"): 1}]])
+    cb = b.transact_(cb, [[cb.root_uuid, K("self"), b.Ref(cb.root_uuid)]])
+    got = b.cb_to_edn(cb)
+    assert got[K("a")] == 1
+    inner = got[K("self")]
+    assert inner[K("a")] == 1
+    assert inner[K("self")] == b.Ref(cb.root_uuid)
+
+    # mutual cycle: two collections pointing at each other
+    cb2 = b.transact_(b.new_cb(), [[None, None, {K("x"): [1]}]])
+    inner_uuid = b.ref_to_uuid(
+        b.get_collection_(cb2).get(K("x"), None) or
+        next(u for u in cb2.collections if u != cb2.root_uuid)
+    )
+    cb2 = b.transact_(
+        cb2, [[inner_uuid, c.root_id, b.Ref(cb2.root_uuid)]]
+    )
+    got2 = b.cb_to_edn(cb2)  # must terminate
+    assert K("x") in got2
+
+
 def test_map_to_nodes():
     """(core_test.cljc:16-21)"""
     cb = b.new_cb()

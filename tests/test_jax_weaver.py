@@ -235,6 +235,90 @@ def test_jax_map_end_to_end():
     assert c.cmap(weaver="jax").causal_to_edn() == {}
 
 
+def test_estimate_runs_device_parity():
+    """The host run estimator equals the device kernel's n_runs EXACTLY
+    on fuzz trees: k_max=estimate never overflows, k_max=estimate-1
+    always does (an overestimate would silently route reweaves to the
+    slower v1 kernel; an underestimate wastes a doomed v2 dispatch)."""
+    import jax.numpy as jnp
+
+    rng = random.Random(0x5EED)
+    for round_ in range(25):
+        sites = [new_site_id() for _ in range(4)]
+        cl = c.clist(*"ab")
+        for _ in range(rng.randrange(1, 16)):
+            cl = cl.insert(rand_node(rng, cl, site_id=rng.choice(sites)))
+        na = NodeArrays.from_nodes_map(cl.ct.nodes)
+        hi, lo = na.id_lanes()
+        args = tuple(map(jnp.asarray, (hi, lo, na.cause_idx, na.vclass,
+                                       na.valid)))
+        est = jaxw.estimate_runs(na.cause_idx, na.vclass, na.valid)
+        _, _, ovf = jaxw.linearize_v2(*args, k_max=est)
+        assert not bool(ovf), f"round {round_}: estimate {est} overestimates"
+        if est > 1:
+            _, _, ovf = jaxw.linearize_v2(*args, k_max=est - 1)
+            assert bool(ovf), f"round {round_}: estimate {est} underestimates"
+
+
+def test_pair_run_budget_derived_from_lanes():
+    """estimate_pair_runs (numpy front-half + estimate_runs) equals the
+    merge kernel's device n_runs on generated pairs, and the derived
+    budget never overflows the batched kernel."""
+    import jax.numpy as jnp
+
+    from cause_tpu import benchgen
+
+    row = benchgen.divergent_pair_lanes(
+        n_base=40, n_div=12, capacity=64, hide_every=3
+    )
+    est = benchgen.estimate_pair_runs(row)
+    args = tuple(jnp.asarray(row[k]) for k in benchgen.LANE_KEYS)
+    *_, ovf = jaxw.merge_weave_kernel_v2(*args, k_max=est)
+    assert not bool(ovf)
+    *_, ovf = jaxw.merge_weave_kernel_v2(*args, k_max=est - 1)
+    assert bool(ovf)
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=6, n_base=40, n_div=12, capacity=64, hide_every=3
+    )
+    k_max = benchgen.pair_run_budget(batch)
+    bargs = tuple(jnp.asarray(batch[k]) for k in benchgen.LANE_KEYS)
+    *_, ovf = jaxw.batched_merge_weave_v2(*bargs, k_max=k_max)
+    assert not np.asarray(ovf).any()
+
+
+def test_jax_map_merge_parity():
+    """merge_map_trees (and CausalMap.merge under weaver="jax") equals
+    the pure pairwise reduce-insert merge on random divergent maps
+    (reference: map.cljc:248-249)."""
+    from cause_tpu.collections import cmap as c_map
+    from cause_tpu.ids import K
+
+    from test_map import rand_map_node
+
+    rng = random.Random(0xC0FFEE)
+    for round_ in range(20):
+        base = c.cmap().assoc(K("seed"), 0)
+        replicas = []
+        for _ in range(2):
+            r = c_map.CausalMap(base.ct.evolve(site_id=new_site_id()))
+            for _ in range(rng.randrange(1, 8)):
+                r = r.insert(rand_map_node(rng, r, r.ct.site_id))
+            replicas.append(r)
+        pure_merged = s.merge_trees(c_map.weave, replicas[0].ct,
+                                    replicas[1].ct)
+        jax_merged = jaxw.merge_map_trees(replicas[0].ct, replicas[1].ct)
+        assert jax_merged.nodes == pure_merged.nodes, f"round {round_}"
+        assert jax_merged.yarns == pure_merged.yarns, f"round {round_}"
+        assert jax_merged.lamport_ts == pure_merged.lamport_ts
+        assert jax_merged.weave == pure_merged.weave, f"round {round_}"
+        # the API dispatch: weaver="jax" maps take the device path
+        via_api = c_map.CausalMap(
+            replicas[0].ct.evolve(weaver="jax")
+        ).merge(c_map.CausalMap(replicas[1].ct.evolve(weaver="jax")))
+        assert via_api.ct.weave == pure_merged.weave
+
+
 def test_linearize_v2_overflow_flag():
     """A run budget below the real run count must raise the flag."""
     import jax.numpy as jnp
