@@ -38,6 +38,7 @@ __all__ = [
     "chain_tree_lanes",
     "divergent_pair_lanes",
     "batched_pair_lanes",
+    "fleet_lanes",
     "estimate_pair_runs",
     "pair_run_budget",
     "merge_wave_scalar",
@@ -229,6 +230,37 @@ def divergent_pair_lanes(
     a = chain_tree_lanes(n_base, n_div, SITE_A, capacity, hide_every, spec)
     b = chain_tree_lanes(n_base, n_div, SITE_B, capacity, hide_every, spec)
     return {k: np.concatenate([a[k], b[k]]) for k in a}
+
+
+def fleet_lanes(
+    n_replicas: int,
+    n_base: int,
+    n_div: int,
+    capacity: int,
+    hide_every: int = 0,
+    spec: PackSpec = DEFAULT_PACK,
+) -> Dict[str, np.ndarray]:
+    """Flattened ``[n_replicas * capacity]`` lanes of a whole fleet: K
+    divergent replicas of one shared base chain, each with its own
+    suffix site and tombstone phase. Feed straight into
+    ``merge_weave_kernel`` — its sort-dedupe union front half is K-ary
+    for free — to converge the entire fleet into ONE tree on device
+    (the north star's "1024 replicas into one" reading)."""
+    n_sites = SITE_A + n_replicas
+    if n_sites > (1 << spec.site_bits):
+        raise OverflowError(f"{n_sites} sites exceed {spec.site_bits} bits")
+    rows = []
+    for r in range(n_replicas):
+        row = chain_tree_lanes(
+            n_base, n_div, SITE_A + r, capacity,
+            hide_every=0, spec=spec,
+        )
+        if hide_every > 0 and n_div > 0:
+            j = np.arange(1, n_div + 1)
+            is_hide = ((j + r) % hide_every) == 0
+            row["vc"][1 + n_base:1 + n_base + n_div][is_hide] = VCLASS_HIDE
+        rows.append(row)
+    return {k: np.concatenate([row[k] for row in rows]) for k in rows[0]}
 
 
 def batched_pair_lanes(

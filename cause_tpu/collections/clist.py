@@ -219,7 +219,16 @@ class CausalList:
         """Converge a whole fleet in one pass: N-way node union + one
         full reweave (the weave is a pure function of the node set, so
         this equals any fold of pairwise merges). No reference
-        analogue — the reference folds pairwise (shared.cljc:300-314)."""
+        analogue — the reference folds pairwise (shared.cljc:300-314).
+        Under ``weaver="jax"`` the union, validations and reweave are
+        all set-algebra/vectorized/device work — no per-node Python
+        loop."""
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return CausalList(
+                jaxw.merge_many_list_trees([self.ct] + [o.ct for o in others])
+            )
         ct = s.union_nodes_many(
             [self.ct] + [o.ct for o in others]
         )

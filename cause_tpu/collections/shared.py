@@ -127,9 +127,11 @@ def spin(ct: CausalTree, node=None, more_nodes=None) -> CausalTree:
     """
     yarns = dict(ct.yarns)
     if node is None:
+        # bulk rebuild: sorted ids grouped by site in one pass — the
+        # incremental path's copy-on-append would be O(n^2) here
         yarns = {}
-        for nid in sorted(ct.nodes):
-            _spin_one(yarns, node_from_kv((nid, ct.nodes[nid])))
+        for nid, (cause, value) in sorted(ct.nodes.items()):
+            yarns.setdefault(nid[1], []).append((nid, cause, value))
     else:
         _spin_one(yarns, node)
         if more_nodes:
@@ -279,20 +281,23 @@ def union_nodes_many(cts) -> CausalTree:
     added = []
     for ct in cts[1:]:
         check_mergeable(first, ct)
-        for nid, body in ct.nodes.items():
-            existing = nodes.get(nid)
-            if existing is not None:
-                if existing != body:
-                    raise CausalError(
-                        "This node is already in the tree and can't be changed.",
-                        {"causes": {"append-only", "edits-not-allowed"},
-                         "existing_node": (nid,) + existing},
-                    )
-                continue
-            if nid[0] > max_new_ts:
-                max_new_ts = nid[0]
-            nodes[nid] = body
-            added.append(nid)
+        other = ct.nodes
+        # set-algebra split (C speed) instead of a per-node branch
+        common = nodes.keys() & other.keys()
+        for nid in common:
+            if nodes[nid] != other[nid]:
+                raise CausalError(
+                    "This node is already in the tree and can't be changed.",
+                    {"causes": {"append-only", "edits-not-allowed"},
+                     "existing_node": (nid,) + nodes[nid]},
+                )
+        new_ids = other.keys() - nodes.keys()
+        nodes.update((nid, other[nid]) for nid in new_ids)
+        added.extend(new_ids)
+    if added:
+        ts_high = max(nid[0] for nid in added)
+        if ts_high > max_new_ts:
+            max_new_ts = ts_high
     for nid in added:
         cause = nodes[nid][0]
         if not is_key(cause) and cause not in nodes:

@@ -139,6 +139,8 @@ class NodeArrays:
     cause_idx: np.ndarray
     vclass: np.ndarray
     valid: np.ndarray
+    cause_hi: np.ndarray
+    cause_lo: np.ndarray
     nodes: list
     interner: SiteInterner
     n: int
@@ -153,10 +155,16 @@ class NodeArrays:
         nodes_map: dict,
         capacity: Optional[int] = None,
         interner: Optional[SiteInterner] = None,
+        spec: PackSpec = DEFAULT_PACK,
     ) -> "NodeArrays":
         """Build device lanes from a ``{id: (cause, value)}`` store.
         Lanes are in sorted id order (so lane index order == id order
-        and every cause precedes its effects)."""
+        and every cause precedes its effects). Column extraction is a
+        handful of comprehensions; cause resolution is one vectorized
+        searchsorted over packed 64-bit id keys — the 10k-node API-level
+        marshal is numpy-bound, not Python-loop-bound."""
+        from ..ids import is_id
+
         ids = sorted(nodes_map)
         n = len(ids)
         cap = capacity or next_pow2(n)
@@ -164,25 +172,77 @@ class NodeArrays:
             raise ValueError(f"capacity {cap} < node count {n}")
         if interner is None:
             interner = SiteInterner(i[1] for i in ids)
-        idx_of = {nid: i for i, nid in enumerate(ids)}
+        bodies = [nodes_map[nid] for nid in ids]
+        nodes = [(nid, c, v) for nid, (c, v) in zip(ids, bodies)]
+
         ts = np.zeros(cap, np.int32)
         site = np.zeros(cap, np.int32)
         tx = np.zeros(cap, np.int32)
-        cause_idx = np.full(cap, -1, np.int32)
         vclass = np.zeros(cap, np.int32)
         valid = np.zeros(cap, bool)
-        nodes = []
-        for i, nid in enumerate(ids):
-            cause, value = nodes_map[nid]
-            ts[i], site[i], tx[i] = nid[0], interner[nid[1]], nid[2]
-            ci = idx_of.get(cause, -1) if isinstance(cause, tuple) else -1
-            cause_idx[i] = ci
-            vclass[i] = vclass_of(value)
-            valid[i] = True
-            nodes.append((nid, cause, value))
+        cause_idx = np.full(cap, -1, np.int32)
+        cause_hi = np.full(cap, -1, np.int32)
+        cause_lo = np.full(cap, -1, np.int32)
+        if n:
+            # dict lookups beat numpy unicode arrays for site interning
+            # (and raise KeyError on a site missing from a shared
+            # interner, which a searchsorted would silently mis-rank)
+            rank = interner.rank
+            ts[:n] = np.fromiter((i[0] for i in ids), np.int64, n)
+            site[:n] = np.fromiter((rank[i[1]] for i in ids), np.int64, n)
+            tx[:n] = np.fromiter((i[2] for i in ids), np.int64, n)
+            vclass[:n] = np.fromiter(
+                (vclass_of(v) for _, v in bodies), np.int32, n
+            )
+            valid[:n] = True
+
+            causes = [c if is_id(c) else None for c, _ in bodies]
+            has_cause = np.fromiter(
+                (c is not None for c in causes), bool, n
+            )
+            if has_cause.any():
+                c_ts = np.fromiter(
+                    (c[0] if c else 0 for c in causes), np.int64, n
+                )
+                # a cause site unknown to the interner can never match a
+                # lane, so it gets the one-past-the-end rank: the packed
+                # query misses and the cause resolves to -1 (dangling)
+                ghost = len(interner)
+                c_site = np.fromiter(
+                    (rank.get(c[1], ghost) if c else 0 for c in causes),
+                    np.int64, n,
+                )
+                c_tx = np.fromiter(
+                    (c[2] if c else 0 for c in causes), np.int64, n
+                )
+                chi = c_ts.astype(np.int32)
+                clo = (c_site.astype(np.int32) << spec.tx_bits) | c_tx.astype(
+                    np.int32
+                )
+                cause_hi[:n] = np.where(has_cause, chi, -1)
+                cause_lo[:n] = np.where(has_cause, clo, -1)
+                # resolve cause -> lane via packed keys (ids sorted =>
+                # packed keys sorted, given spec bounds checked below)
+                key = (ts[:n].astype(np.int64) << 32) | (
+                    spec.pack_lo(site[:n], tx[:n]).astype(np.int64)
+                    & 0xFFFFFFFF
+                )
+                q = (chi.astype(np.int64) << 32) | (
+                    clo.astype(np.int64) & 0xFFFFFFFF
+                )
+                pos = np.searchsorted(key, q)
+                pos_c = np.clip(pos, 0, n - 1)
+                found = has_cause & (key[pos_c] == q)
+                cause_idx[:n] = np.where(found, pos_c, -1)
+            max_tx_all = int(
+                max(int(tx[:n].max(initial=0)),
+                    int(c_tx.max(initial=0)) if has_cause.any() else 0)
+            )
+            spec.check(int(ts[:n].max(initial=0)), len(interner), max_tx_all)
         return cls(
             ts=ts, site=site, tx=tx, cause_idx=cause_idx, vclass=vclass,
-            valid=valid, nodes=nodes, interner=interner, n=n,
+            valid=valid, cause_hi=cause_hi, cause_lo=cause_lo, nodes=nodes,
+            interner=interner, n=n,
         )
 
     def id_lanes(self, spec: PackSpec = DEFAULT_PACK):
@@ -196,21 +256,12 @@ class NodeArrays:
         return hi, lo
 
     def cause_lanes(self, spec: PackSpec = DEFAULT_PACK):
-        """(hi, lo) lanes of each node's cause id, or (-1, -1) when the
-        cause is not an id (root sentinel, key causes, padding)."""
-        from ..ids import is_id
-
-        hi = np.full(self.capacity, -1, np.int32)
-        lo = np.full(self.capacity, -1, np.int32)
-        for i in range(self.n):
-            cause = self.nodes[i][1]
-            # any id-shaped cause, even one living in another replica's
-            # tree (merges resolve causes against the union)
-            if is_id(cause):
-                hi[i] = cause[0]
-                lo[i] = int(spec.pack_lo(np.int32(self.interner[cause[1]]),
-                                         np.int32(cause[2])))
-        return hi, lo
+        """(hi, lo) lanes of each node's cause id — any id-shaped cause,
+        even one living in another replica's tree (merges resolve causes
+        against the union) — or (-1, -1) when the cause is not an id
+        (root sentinel, key causes, padding). Precomputed vectorized in
+        ``from_nodes_map``."""
+        return self.cause_hi, self.cause_lo
 
 
 def map_lanes(nodes_map):

@@ -68,6 +68,7 @@ __all__ = [
     "refresh_map_weave",
     "merge_list_trees",
     "merge_map_trees",
+    "merge_many_list_trees",
     "merge_weave_kernel",
     "merge_weave_kernel_v2",
     "batched_merge_weave",
@@ -513,6 +514,62 @@ def merge_map_trees(ct1, ct2):
     from ..collections import shared as s
 
     return refresh_map_weave(s.union_nodes(ct1, ct2))
+
+
+def merge_many_list_trees(cts):
+    """Converge a whole fleet of K list replicas into one tree with no
+    per-node Python loop: the node-store union is C-speed dict/set
+    algebra, every validation the pairwise path performs is done
+    vectorized (append-only via dict-items subset tests, cause-must-
+    exist via the marshalled cause_idx lanes), and the single reweave
+    of the union runs on device. Equals any fold of pairwise merges
+    (the weave is a pure function of the node set; reference folds
+    pairwise, shared.cljc:300-314)."""
+    from ..collections import shared as s
+
+    cts = list(cts)
+    if not cts:
+        raise s.CausalError("Nothing to merge.", {"causes": {"empty-fleet"}})
+    first = cts[0]
+    for ct in cts[1:]:
+        s.check_mergeable(first, ct)
+
+    nodes = {}
+    for ct in cts:
+        nodes.update(ct.nodes)
+    for ct in cts:
+        # C-speed subset test; on failure only, hunt the offender
+        if not (ct.nodes.items() <= nodes.items()):
+            for nid, body in ct.nodes.items():
+                if nodes[nid] != body:
+                    raise s.CausalError(
+                        "This node is already in the tree and can't be "
+                        "changed.",
+                        {"causes": {"append-only", "edits-not-allowed"},
+                         "existing_node": (nid,) + nodes[nid]},
+                    )
+
+    na = NodeArrays.from_nodes_map(nodes)
+    n = na.n
+    dangling = (na.cause_idx[:n] == -1) & (na.cause_hi[:n] >= 0)
+    if dangling.any():
+        i = int(np.flatnonzero(dangling)[0])
+        raise s.CausalError(
+            "The cause of this node is not in the tree.",
+            {"causes": {"cause-must-exist"}, "node": na.nodes[i]},
+        )
+
+    rank, _ = weave_arrays(na)
+    order = np.argsort(rank[: na.capacity], kind="stable")
+    weave = [na.nodes[i] for i in order[:n]]
+    # na.nodes is already in sorted id order -> yarns group in one pass
+    yarns = {}
+    for node in na.nodes:
+        yarns.setdefault(node[0][1], []).append(node)
+    lamport = max(first.lamport_ts, int(na.ts[:n].max(initial=0)))
+    return first.evolve(
+        nodes=nodes, yarns=yarns, weave=weave, lamport_ts=lamport
+    )
 
 
 # ------------------------- batched merge kernel -------------------------

@@ -41,9 +41,33 @@ def test_merge_all_equals_fold(weaver):
     folded = fold_merge(fleet)
     converged = c.merge_all(fleet[0], *fleet[1:])
     assert converged.ct.nodes == folded.ct.nodes
+    assert converged.ct.yarns == folded.ct.yarns
     assert converged.ct.weave == folded.ct.weave
     assert converged.ct.lamport_ts == folded.ct.lamport_ts
     assert converged.causal_to_edn() == folded.causal_to_edn()
+
+
+def test_jax_fleet_merge_validations():
+    """The all-device fleet path raises the same CausalErrors as the
+    pairwise fold: append-only value conflicts and dangling causes."""
+    from cause_tpu.weaver import jaxw
+
+    a = c.clist(weaver="jax")
+    nid = (1, "siteA________Z", 0)
+    a2 = a.insert((nid, c.root_id, "x"))
+    b2 = c_list.CausalList(a.ct).insert((nid, c.root_id, "y"))
+    with pytest.raises(c.CausalError):
+        jaxw.merge_many_list_trees([a2.ct, b2.ct])
+
+    base = c.clist("a", weaver="jax")
+    b = c_list.CausalList(base.ct.evolve(site_id=new_site_id()))
+    bad_nodes = dict(b.ct.nodes)
+    bad_nodes[(9, b.ct.site_id, 0)] = ((7, "ghost________", 0), "X")
+    bad = b.ct.evolve(nodes=bad_nodes)
+    with pytest.raises(c.CausalError):
+        jaxw.merge_many_list_trees([base.ct, bad])
+    with pytest.raises(c.CausalError):
+        jaxw.merge_many_list_trees([])
 
 
 def test_merge_all_order_invariant():
