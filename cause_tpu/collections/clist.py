@@ -259,6 +259,39 @@ class CausalList:
     def __iter__(self):
         return iter(causal_list_to_list(self.ct))
 
+    def __getitem__(self, i):
+        """Visible node(s) by weave position — the indexed view of the
+        same sequence iteration yields (nodes, not values; the
+        reference's seq/nth contract, list.cljc:94-95). Negative
+        indices and slices follow Python list semantics."""
+        return causal_list_to_list(self.ct)[i]
+
+    def nth(self, i, *default):
+        """Node at position ``i``, or ``default`` when out of range
+        (Clojure ``nth``'s 3-arity — negative indices are out of range,
+        as in Clojure; use ``cl[i]`` for Python negative indexing)."""
+        nodes = causal_list_to_list(self.ct)
+        if 0 <= i < len(nodes):
+            return nodes[i]
+        if default:
+            return default[0]
+        raise IndexError(f"nth: index {i} out of range for {len(nodes)}")
+
+    def get(self, i, not_found=None):
+        """Rendered *value* at position ``i`` (``get`` on a Clojure
+        sequential: the materialized element, not the node)."""
+        vals = causal_list_to_edn(self.ct)
+        if isinstance(i, int) and -len(vals) <= i < len(vals):
+            return vals[i]
+        return not_found
+
+    # -- IObj/IMeta analogue (list.cljc:97-101) --
+    def with_meta(self, m) -> "CausalList":
+        return CausalList(self.ct.evolve(meta=m))
+
+    def meta(self):
+        return self.ct.meta
+
     def __eq__(self, other) -> bool:
         return isinstance(other, CausalList) and self.ct == other.ct
 

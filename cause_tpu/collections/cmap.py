@@ -39,6 +39,40 @@ __all__ = [
 BLANK = object()
 
 
+_MISSING = object()
+
+
+def _update_in(container, path, f, args):
+    """The one ``update_in`` recursion, over CausalMap-likes (anything
+    with ``get``/``assoc``) and plain dicts. A missing or
+    non-associative intermediate raises a CausalError naming the
+    offending segment. Mirrors ``get_in``'s presence semantics: a
+    dict key explicitly holding None is present (just not associative);
+    a CausalMap register holding None is indistinguishable from absent."""
+    k = path[0]
+    is_cmap = hasattr(container, "assoc")
+    if len(path) == 1:
+        new_v = f(container.get(k), *args)
+        return container.assoc(k, new_v) if is_cmap else {**container, k: new_v}
+    inner = container.get(k) if is_cmap else container.get(k, _MISSING)
+    missing = inner is None if is_cmap else inner is _MISSING
+    if missing:
+        raise s.CausalError(
+            "update_in: missing intermediate key.",
+            {"causes": {"missing-path-segment"}, "key": k,
+             "path": list(path)},
+        )
+    if not hasattr(inner, "assoc") and not isinstance(inner, dict):
+        raise s.CausalError(
+            "update_in: intermediate value is not associative.",
+            {"causes": {"not-associative"}, "key": k,
+             "value_type": type(inner).__name__},
+        )
+    new_inner = _update_in(inner, path[1:], f, args)
+    return (container.assoc(k, new_inner) if is_cmap
+            else {**container, k: new_inner})
+
+
 def new_causal_tree(weaver: str = "pure") -> CausalTree:
     """A fresh map tree; the weave is a dict of key -> list-weave
     (map.cljc:12-19)."""
@@ -285,6 +319,63 @@ class CausalMap:
 
     def items(self):
         return causal_map_to_edn(self.ct).items()
+
+    _MISSING = _MISSING
+
+    def get_in(self, path, not_found=None):
+        """Walk ``path`` through nested gettable values — CausalMaps,
+        plain dicts, and sequences indexed by int (Clojure ``get-in``
+        over associative values; exercised at map_test.cljc:56-61).
+        A plain-dict key explicitly holding None is *present* (returned
+        as None); a CausalMap register holding None is indistinguishable
+        from an absent key — the ``get``/``active_node`` contract."""
+        cur = self
+        for k in path:
+            if isinstance(cur, dict):
+                cur = cur.get(k, CausalMap._MISSING)
+                if cur is CausalMap._MISSING:
+                    return not_found
+            elif hasattr(cur, "get"):
+                cur = cur.get(k)
+                if cur is None:
+                    return not_found
+            elif (isinstance(cur, (list, tuple)) and isinstance(k, int)
+                  and 0 <= k < len(cur)):
+                cur = cur[k]
+            else:
+                return not_found
+        return cur
+
+    def update(self, k, f, *args) -> "CausalMap":
+        """Assoc ``f(current, *args)`` at ``k`` (Clojure ``update``)."""
+        return self.assoc(k, f(self.get(k), *args))
+
+    def update_in(self, path, f, *args) -> "CausalMap":
+        """Apply ``f`` at a nested path (Clojure ``update-in``).
+        Intermediates may be CausalMaps or plain dicts; a missing
+        intermediate raises a CausalError naming the absent segment
+        (rather than Clojure's silent nil->map auto-create, which would
+        mint an un-caused collection inside a CRDT)."""
+        path = list(path)
+        if not path:
+            raise ValueError("update_in: empty path")
+        return _update_in(self, path, f, args)
+
+    def reduce_kv(self, f, init):
+        """Fold ``f(acc, k, v)`` over the rendered map — the IKVReduce
+        analogue, which the reference also defines over the
+        materialized EDN (map.cljc:141-143)."""
+        acc = init
+        for k, v in causal_map_to_edn(self.ct).items():
+            acc = f(acc, k, v)
+        return acc
+
+    # -- IObj/IMeta analogue (map.cljc:159-163) --
+    def with_meta(self, m) -> "CausalMap":
+        return CausalMap(self.ct.evolve(meta=m))
+
+    def meta(self):
+        return self.ct.meta
 
     def __eq__(self, other) -> bool:
         return isinstance(other, CausalMap) and self.ct == other.ct

@@ -84,6 +84,10 @@ class CausalTree:
     yarns: Dict[str, list]
     weave: Any
     weaver: str = "pure"
+    # IObj/IMeta analogue (list.cljc:97-101, map.cljc:159-163): an
+    # arbitrary attachment that never affects equality and is not
+    # serialized — Clojure metadata semantics.
+    meta: Any = field(default=None, compare=False)
 
     def evolve(self, **kw) -> "CausalTree":
         return replace(self, **kw)

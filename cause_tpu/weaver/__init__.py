@@ -8,10 +8,13 @@
   lazily so host-only use never pays the JAX import.
 - :mod:`cause_tpu.weaver.arrays` — host<->device marshalling (site-id
   interning, structure-of-arrays node buffers, id packing).
+- :mod:`cause_tpu.native` — the C++ host backend: O(n) reweaves and
+  merges compiled on first use (falls back to pure if the toolchain
+  is unavailable; see :func:`cause_tpu.native.available`).
 
-Selected per-tree via the ``weaver`` field ("pure" | "jax").
+Selected per-tree via the ``weaver`` field ("pure" | "native" | "jax").
 """
 
 from . import pure  # noqa: F401
 
-BACKENDS = ("pure", "jax")
+BACKENDS = ("pure", "native", "jax")

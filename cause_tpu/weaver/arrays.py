@@ -144,6 +144,11 @@ class NodeArrays:
     nodes: list
     interner: SiteInterner
     n: int
+    # the PackSpec the (cause_)hi/lo lanes were built with, and whether
+    # the ids actually fit it (False = host-only marshal: cause_idx is
+    # dict-resolved, device lanes raise)
+    spec: PackSpec = DEFAULT_PACK
+    spec_ok: bool = True
 
     @property
     def capacity(self) -> int:
@@ -200,7 +205,20 @@ class NodeArrays:
             has_cause = np.fromiter(
                 (c is not None for c in causes), bool, n
             )
+            c_tx_max = 0
             if has_cause.any():
+                c_tx_max = max(c[2] for c in causes if c)
+            max_tx_all = int(max(int(tx[:n].max(initial=0)), c_tx_max))
+            try:
+                spec.check(int(ts[:n].max(initial=0)), len(interner),
+                           max_tx_all)
+                spec_ok = True
+            except OverflowError:
+                # the host-only backends (nativew) need no (hi, lo)
+                # packing; resolve causes by dict instead and leave the
+                # device lanes unusable (id_lanes/cause_lanes re-check)
+                spec_ok = False
+            if has_cause.any() and spec_ok:
                 c_ts = np.fromiter(
                     (c[0] if c else 0 for c in causes), np.int64, n
                 )
@@ -222,7 +240,7 @@ class NodeArrays:
                 cause_hi[:n] = np.where(has_cause, chi, -1)
                 cause_lo[:n] = np.where(has_cause, clo, -1)
                 # resolve cause -> lane via packed keys (ids sorted =>
-                # packed keys sorted, given spec bounds checked below)
+                # packed keys sorted, given the spec bounds hold)
                 key = (ts[:n].astype(np.int64) << 32) | (
                     spec.pack_lo(site[:n], tx[:n]).astype(np.int64)
                     & 0xFFFFFFFF
@@ -234,20 +252,31 @@ class NodeArrays:
                 pos_c = np.clip(pos, 0, n - 1)
                 found = has_cause & (key[pos_c] == q)
                 cause_idx[:n] = np.where(found, pos_c, -1)
-            max_tx_all = int(
-                max(int(tx[:n].max(initial=0)),
-                    int(c_tx.max(initial=0)) if has_cause.any() else 0)
-            )
-            spec.check(int(ts[:n].max(initial=0)), len(interner), max_tx_all)
+            elif has_cause.any():
+                idx_of = {nid: i for i, nid in enumerate(ids)}
+                cause_idx[:n] = np.fromiter(
+                    (idx_of.get(c, -1) if c else -1 for c in causes),
+                    np.int64, n,
+                )
+        else:
+            spec_ok = True
         return cls(
             ts=ts, site=site, tx=tx, cause_idx=cause_idx, vclass=vclass,
             valid=valid, cause_hi=cause_hi, cause_lo=cause_lo, nodes=nodes,
-            interner=interner, n=n,
+            interner=interner, n=n, spec=spec, spec_ok=spec_ok,
         )
 
-    def id_lanes(self, spec: PackSpec = DEFAULT_PACK):
+    def id_lanes(self, spec: Optional[PackSpec] = None):
         """(hi, lo) int32 id lanes; padding lanes get int32 max so they
-        sort last (real ids never reach int32 max by ``check``)."""
+        sort last (real ids never reach int32 max by ``check``). The
+        layout is fixed at marshal time — a different spec requires a
+        re-marshal (so id and cause lanes can never disagree)."""
+        if spec is not None and spec != self.spec:
+            raise ValueError(
+                "id_lanes are packed with the from_nodes_map spec "
+                f"{self.spec}; re-marshal to use {spec}"
+            )
+        spec = self.spec
         max_ts = int(self.ts[: self.n].max(initial=0))
         max_tx = int(self.tx[: self.n].max(initial=0))
         spec.check(max_ts, len(self.interner), max_tx)
@@ -255,12 +284,24 @@ class NodeArrays:
         lo = np.where(self.valid, spec.pack_lo(self.site, self.tx), I32_MAX)
         return hi, lo
 
-    def cause_lanes(self, spec: PackSpec = DEFAULT_PACK):
+    def cause_lanes(self, spec: Optional[PackSpec] = None):
         """(hi, lo) lanes of each node's cause id — any id-shaped cause,
         even one living in another replica's tree (merges resolve causes
         against the union) — or (-1, -1) when the cause is not an id
-        (root sentinel, key causes, padding). Precomputed vectorized in
-        ``from_nodes_map``."""
+        (root sentinel, key causes, padding). Precomputed in
+        ``from_nodes_map`` with its ``spec``; asking for a different
+        layout (or one the ids overflow) is an error, not a silent
+        mismatch against ``id_lanes``."""
+        if spec is not None and spec != self.spec:
+            raise ValueError(
+                "cause_lanes were packed with the from_nodes_map spec "
+                f"{self.spec}; re-marshal to use {spec}"
+            )
+        if not self.spec_ok:
+            raise OverflowError(
+                "ids exceed the PackSpec bit layout; device lanes are "
+                "unavailable (host backends can still use cause_idx)"
+            )
         return self.cause_hi, self.cause_lo
 
 

@@ -551,13 +551,39 @@ def merge_many_list_trees(cts):
 
     na = NodeArrays.from_nodes_map(nodes)
     n = na.n
-    dangling = (na.cause_idx[:n] == -1) & (na.cause_hi[:n] >= 0)
-    if dangling.any():
-        i = int(np.flatnonzero(dangling)[0])
-        raise s.CausalError(
-            "The cause of this node is not in the tree.",
-            {"causes": {"cause-must-exist"}, "node": na.nodes[i]},
+    if na.spec_ok:
+        has_cause = na.cause_hi[:n] >= 0
+    else:
+        # ids overflowed the PackSpec: cause_hi is all -1, so derive
+        # "has an id-shaped cause" from the host nodes — the validation
+        # must not silently vanish with the device lanes
+        from ..ids import is_id
+
+        has_cause = np.fromiter(
+            (is_id(cause) for _, cause, _ in na.nodes), bool, n
         )
+    dangling = (na.cause_idx[:n] == -1) & has_cause
+    if dangling.any():
+        # only *incoming* nodes are validated — nodes already in the
+        # first tree merge as-is, exactly like the pure N-way union
+        # (union_nodes_many checks `added` only) and the pairwise paths,
+        # so every backend accepts the same fleets
+        first_ids = first.nodes
+        for i in np.flatnonzero(dangling):
+            if na.nodes[i][0] not in first_ids:
+                raise s.CausalError(
+                    "The cause of this node is not in the tree.",
+                    {"causes": {"cause-must-exist"}, "node": na.nodes[i]},
+                )
+        # a fleet accepted with pre-existing dangling causes (weft
+        # gibberish) is outside the device domain: the kernel parents
+        # dangling nodes under root, the pure scan does not. Fall back
+        # to the pure reweave of the union — same stance as nativew's
+        # OutsideDomain path — so every backend converges identically.
+        from ..collections import clist as c_list
+
+        ct = s.union_nodes_many([first.evolve(weaver="pure")] + cts[1:])
+        return c_list.weave(ct).evolve(weaver=first.weaver)
 
     rank, _ = weave_arrays(na)
     order = np.argsort(rank[: na.capacity], kind="stable")

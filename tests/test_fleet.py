@@ -9,6 +9,7 @@ import cause_tpu as c
 from cause_tpu import native
 from cause_tpu.collections import clist as c_list
 from cause_tpu.collections import cmap as c_map
+from cause_tpu.collections import shared as s
 from cause_tpu.ids import K, new_site_id
 
 from test_list import rand_node
@@ -68,6 +69,52 @@ def test_jax_fleet_merge_validations():
         jaxw.merge_many_list_trees([base.ct, bad])
     with pytest.raises(c.CausalError):
         jaxw.merge_many_list_trees([])
+
+
+def test_jax_fleet_accepts_preexisting_dangling_cause():
+    """Only *incoming* nodes are cause-validated: a first tree already
+    carrying a dangling cause (weft gibberish) merges under every
+    backend alike — jax merge_all must not reject fleets the pure
+    N-way union accepts."""
+    from cause_tpu.weaver import jaxw
+
+    base = c.clist(*"abc", weaver="jax")
+    nodes = list(base)
+    # drop a mid-chain node from EVERY replica: the dangling cause is
+    # pre-existing in the first tree and never re-supplied by the union
+    broken = base.ct.evolve(
+        nodes={k: v for k, v in base.ct.nodes.items() if k != nodes[1][0]}
+    )
+    other = c_list.CausalList(
+        broken.evolve(site_id=new_site_id())
+    ).conj("!")
+    via_jax = jaxw.merge_many_list_trees([broken, other.ct])
+    pure_union = s.union_nodes_many(
+        [broken.evolve(weaver="pure"), other.ct]
+    )
+    pure_fold = c_list.weave(pure_union)
+    assert via_jax.nodes == pure_fold.nodes
+    # the weave itself must match the pure backend, not just the nodes —
+    # dangling trees are off the device domain and take the pure path
+    assert via_jax.weave == pure_fold.weave
+    # an *incoming* dangling cause still raises
+    alien = broken.evolve(site_id=new_site_id())
+    bad_nodes = dict(alien.nodes)
+    bad_nodes[(9, alien.site_id, 0)] = ((8, "ghost________", 0), "X")
+    with pytest.raises(c.CausalError):
+        jaxw.merge_many_list_trees(
+            [base.ct, alien.evolve(nodes=bad_nodes)]
+        )
+    # ...including when the fleet's ids overflow the PackSpec (device
+    # lanes unavailable): the validation must not silently vanish
+    overflow_nodes = dict(alien.nodes)
+    overflow_nodes[(9, alien.site_id, 0)] = (
+        (8, "ghost________", 20_000), "X"  # cause tx >= 2^13
+    )
+    with pytest.raises(c.CausalError):
+        jaxw.merge_many_list_trees(
+            [base.ct, alien.evolve(nodes=overflow_nodes)]
+        )
 
 
 def test_merge_all_order_invariant():

@@ -269,6 +269,43 @@ def test_core_list_protocol():
     assert isinstance(hash(c.clist("foo")), int)
 
 
+def test_list_indexing_and_nth():
+    """Indexed access is the same sequence iteration yields (nodes, in
+    weave order); ``get`` returns the rendered value (list_test.cljc's
+    protocol surface plus the nth/get arities left TODO there)."""
+    node = ((1, "site-id", 0), ROOT_ID, "foo")
+    cl = c.clist().insert(node).append(ROOT_ID, "bar")
+    assert cl[0] == list(cl)[0]
+    assert cl[1] == node
+    assert cl[-1] == node
+    assert cl[0:2] == list(cl)
+    assert cl.nth(1) == node
+    assert cl.nth(9, "dflt") == "dflt"
+    assert cl.nth(-1, "dflt") == "dflt"  # Clojure nth: negatives are OOR
+    with pytest.raises(IndexError):
+        cl.nth(9)
+    assert cl.get(0) == "bar"
+    assert cl.get(1) == "foo"
+    assert cl.get(-1) == "foo"
+    assert cl.get(9) is None
+    assert cl.get(9, "dflt") == "dflt"
+    assert c.clist().get(0) is None
+
+
+def test_list_meta():
+    """IObj/IMeta analogue (list.cljc:97-101): metadata rides along,
+    never affects equality, and survives nothing it shouldn't."""
+    cl = c.clist("a")
+    assert cl.meta() is None
+    cm = cl.with_meta({"tag": 1})
+    assert cm.meta() == {"tag": 1}
+    assert cm == cl  # meta is equality-transparent
+    assert cm.causal_to_edn() == cl.causal_to_edn()
+    # ops on the same ct preserve it; with_meta(None) clears it
+    assert cm.conj("b").ct.meta == {"tag": 1}
+    assert cm.with_meta(None).meta() is None
+
+
 def test_insert_validations():
     """shared.cljc:163-181 error cases."""
     cl = c.clist()

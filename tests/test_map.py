@@ -105,6 +105,70 @@ def test_core_map_protocol():
     )
 
 
+def test_map_get_in_update_in():
+    """Nested access/update through CausalMap values
+    (map_test.cljc:56-64)."""
+    from cause_tpu.collections.cmap import CausalMap
+
+    nested = c.cmap("foo", c.cmap("foo", "bar"))
+    assert nested.get_in(["foo", "foo"]) == "bar"
+    assert nested.get_in(["foo", "nope"]) is None
+    assert nested.get_in(["nope", "foo"], "dflt") == "dflt"
+
+    updated = nested.update("foo", CausalMap.assoc, "foo", "boo")
+    assert updated.get_in(["foo", "foo"]) == "boo"
+
+    counts = c.cmap("foo", c.cmap("foo", 1))
+    bumped = counts.update_in(["foo", "foo"], lambda v: v + 1)
+    assert bumped.get_in(["foo", "foo"]) == 2
+    with pytest.raises(ValueError):
+        counts.update_in([], lambda v: v)
+
+    # plain-dict and sequence intermediates
+    mixed = c.cmap("d", {"x": 1}, "l", [10, 20])
+    assert mixed.get_in(["d", "x"]) == 1
+    assert mixed.get_in(["l", 0]) == 10
+    assert mixed.get_in(["l", 9], "dflt") == "dflt"
+    assert mixed.update_in(["d", "x"], lambda v: v + 1).get_in(["d", "x"]) == 2
+    # missing intermediate: a clear CausalError, not AttributeError
+    with pytest.raises(c.CausalError) as ei:
+        mixed.update_in(["nope", "x"], lambda v: v)
+    assert "missing-path-segment" in ei.value.info["causes"]
+    with pytest.raises(c.CausalError) as ei:
+        mixed.update_in(["l", 0, "deep"], lambda v: v)
+    assert "not-associative" in ei.value.info["causes"]
+    # present-but-not-associative inside a dict intermediate
+    with pytest.raises(c.CausalError) as ei:
+        c.cmap("d", {"l": [1]}).update_in(["d", "l", 0], lambda v: v)
+    assert "not-associative" in ei.value.info["causes"]
+    # an explicitly stored None in a plain dict is present, not missing
+    assert c.cmap("d", {"x": None}).get_in(["d", "x"], "dflt") is None
+    # ...and update_in agrees: present-but-None is not-associative
+    with pytest.raises(c.CausalError) as ei:
+        c.cmap("d", {"x": None}).update_in(["d", "x", "deep"], lambda v: v)
+    assert "not-associative" in ei.value.info["causes"]
+
+
+def test_map_reduce_kv():
+    """IKVReduce analogue over the rendered map (map.cljc:141-143)."""
+    cm = c.cmap("a", 1, "b", 2, "c", 3)
+    total = cm.reduce_kv(lambda acc, k, v: acc + v, 0)
+    assert total == 6
+    keys = cm.reduce_kv(lambda acc, k, v: acc | {k}, set())
+    assert keys == {"a", "b", "c"}
+    assert c.cmap().reduce_kv(lambda acc, k, v: acc + 1, 0) == 0
+
+
+def test_map_meta():
+    """IObj/IMeta analogue (map.cljc:159-163)."""
+    cm = c.cmap("k", "v")
+    assert cm.meta() is None
+    tagged = cm.with_meta({"src": "test"})
+    assert tagged.meta() == {"src": "test"}
+    assert tagged == cm
+    assert tagged.assoc("k2", "v2").ct.meta == {"src": "test"}
+
+
 def test_assoc_skips_equal_value():
     """map.cljc:75-81: setting a key to its current value writes no node."""
     cm = c.cmap("k", 1)

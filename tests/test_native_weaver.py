@@ -148,6 +148,40 @@ def test_off_domain_falls_back():
     assert nativew.refresh_map_weave(cm.ct).weave == pure_map_weave(cm.ct)
 
 
+def test_native_handles_out_of_packspec_ids():
+    """The native backend needs no (hi, lo) packing, so ids beyond the
+    PackSpec bit budget (tx >= 2^13 here) must still weave — only the
+    device lanes are off-limits for such trees."""
+    from cause_tpu.ids import ROOT_ID
+
+    cl = c.clist("a", weaver="native")
+    big_tx = ((cl.get_ts() + 1, cl.get_site_id(), 10_000), ROOT_ID, "x")
+    cl = cl.insert(big_tx)
+    assert cl.ct.weave == pure_list_weave(cl.ct)
+    assert "x" in cl.causal_to_edn()
+    # the device marshal of the same tree refuses cleanly
+    from cause_tpu.weaver.arrays import NodeArrays
+
+    na = NodeArrays.from_nodes_map(cl.ct.nodes)
+    assert not na.spec_ok
+    with pytest.raises(OverflowError):
+        na.id_lanes()
+    with pytest.raises(OverflowError):
+        na.cause_lanes()
+
+
+def test_cause_lanes_spec_mismatch_raises():
+    """cause_lanes are packed at marshal time; asking for a different
+    layout must be an error, not a silent mismatch with id_lanes."""
+    from cause_tpu.weaver.arrays import NodeArrays, PackSpec
+
+    cl = c.clist("a", "b")
+    na = NodeArrays.from_nodes_map(cl.ct.nodes)
+    assert na.cause_lanes() == (pytest.approx(na.cause_hi), pytest.approx(na.cause_lo))
+    with pytest.raises(ValueError):
+        na.cause_lanes(PackSpec(site_bits=20, tx_bits=11))
+
+
 def test_weft_gibberish_falls_back():
     """Weft cuts can orphan causes; the native list path must fall back
     and match the pure rebuild exactly — including on a tree whose
