@@ -91,28 +91,30 @@ def measure(platform: str) -> dict:
     )
     args = [jax.device_put(batch[k]) for k in LANE_KEYS]
 
-    k_max = benchgen.pair_run_budget(batch)
+    budget = benchgen.pair_run_budget(batch)
 
-    def step(k: int) -> None:
+    def step(k: int, kernel: str) -> None:
         # one transfer fetches checksum + overflow and forces execution
-        out = np.asarray(merge_wave_scalar(*args, k_max=k))
+        out = np.asarray(merge_wave_scalar(*args, k_max=k, kernel=kernel))
         if k and out[1]:  # overflowed rows carry garbage ranks
             raise _Overflow()
 
-    # compile + warmup; an unsampled row blowing the sampled run budget
-    # is recoverable — raise it, then fall back to the uncompressed
-    # kernel (k_max=0, which cannot overflow) before giving up
-    for k_max in (k_max, 2 * k_max, 0):
+    # compile + warmup; the fastest kernel (v3 sparse-irregular) first,
+    # then the chain-compressed v2 with a doubled budget, then the
+    # uncompressed v1 (k_max=0, cannot overflow) before giving up.
+    # An unsampled row blowing the sampled run budget is recoverable.
+    for k_max, kernel in ((budget, "v3"), (2 * budget, "v3"),
+                          (2 * budget, "v2"), (0, "v1")):
         try:
-            step(k_max)
+            step(k_max, kernel)
             break
         except _Overflow:
-            print(f"bench: run budget {k_max} overflowed; retrying",
-                  file=sys.stderr)
+            print(f"bench: run budget {k_max} ({kernel}) overflowed; "
+                  "retrying", file=sys.stderr)
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        step(k_max)
+        step(k_max, kernel)
         times.append((time.perf_counter() - t0) * 1000.0)
     p50 = float(np.median(times))
 

@@ -108,19 +108,21 @@ def pair_run_budget(batch: Dict[str, np.ndarray], sample_rows: int = 4) -> int:
 _scalar_programs: Dict = {}
 
 
-def merge_wave_scalar(*args, k_max: int = 0):
+def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
     """The shared timed program of the merge benchmarks (bench.py and
     the CLI's config 5): the full batched merge+weave reduced to one
     checksum scalar, because on the axon-tunneled TPU
     ``jax.block_until_ready`` does not actually block and a 4-byte
     device->host transfer is the only reliable sync point.
 
-    ``k_max`` > 0 selects the chain-compressed kernel with that run
-    budget and returns a length-2 device array ``[checksum,
-    n_overflowed_rows]`` (one transfer fetches both); the default 0
-    runs the uncompressed kernel and returns just the checksum.
+    ``k_max`` > 0 selects a compressed kernel — ``kernel`` picks which
+    ("v2" chain-compressed, "v3" sparse-irregular) — with that run
+    budget, returning a length-2 device array ``[checksum,
+    n_overflowed_rows]`` (one transfer fetches both); ``k_max=0`` runs
+    the uncompressed v1 kernel and returns just the checksum.
     """
-    program = _scalar_programs.get(k_max)
+    key = (k_max, kernel if k_max > 0 else "v1")
+    program = _scalar_programs.get(key)
     if program is None:
         import jax
         import jax.numpy as jnp
@@ -136,10 +138,17 @@ def merge_wave_scalar(*args, k_max: int = 0):
             )
 
         if k_max > 0:
+            if kernel == "v3":
+                from .weaver.jaxw3 import batched_merge_weave_v3
+
+                batched = batched_merge_weave_v3
+            else:
+                batched = batched_merge_weave_v2
+
             @jax.jit
             def program(*a):
                 order, rank, visible, conflict, overflow = (
-                    batched_merge_weave_v2(*a, k_max=k_max)
+                    batched(*a, k_max=k_max)
                 )
                 return jnp.stack([
                     _checksum(order, rank, visible, conflict),
@@ -150,7 +159,7 @@ def merge_wave_scalar(*args, k_max: int = 0):
             def program(*a):
                 return _checksum(*jax.vmap(merge_weave_kernel)(*a))
 
-        _scalar_programs[k_max] = program
+        _scalar_programs[key] = program
     return program(*args)
 
 # synthetic site ranks (order-preserving: "0" sorts first, suffix sites

@@ -263,7 +263,11 @@ class CausalList:
         """Visible node(s) by weave position — the indexed view of the
         same sequence iteration yields (nodes, not values; the
         reference's seq/nth contract, list.cljc:94-95). Negative
-        indices and slices follow Python list semantics."""
+        indices and slices follow Python list semantics.
+
+        Each indexed access materializes the visible-node list (O(n));
+        for bulk access iterate once (``list(cl)``) or render once
+        (``causal_to_edn``) instead of indexing in a loop."""
         return causal_list_to_list(self.ct)[i]
 
     def nth(self, i, *default):

@@ -276,6 +276,13 @@ class NodeArrays:
                 "id_lanes are packed with the from_nodes_map spec "
                 f"{self.spec}; re-marshal to use {spec}"
             )
+        if not self.spec_ok:
+            # covers cause-id overflow too (a node-only re-check would
+            # let an overflowed cause slip through as silently dangling)
+            raise OverflowError(
+                "ids exceed the PackSpec bit layout; device lanes are "
+                "unavailable (host backends can still use cause_idx)"
+            )
         spec = self.spec
         max_ts = int(self.ts[: self.n].max(initial=0))
         max_tx = int(self.tx[: self.n].max(initial=0))

@@ -490,8 +490,16 @@ def weave_arrays(na: NodeArrays) -> Tuple[np.ndarray, np.ndarray]:
 def refresh_list_weave(ct):
     """Full list-weave rebuild on device (the ``weaver="jax"`` path of
     clist.weave). Produces the identical weave list the pure scan
-    would."""
+    would. Ids beyond the PackSpec bit layout are off the device
+    domain — fall back to the pure rebuild, same stance as nativew's
+    OutsideDomain path, so every backend weaves the same trees."""
     na = NodeArrays.from_nodes_map(ct.nodes)
+    if not na.spec_ok:
+        from ..collections import clist as c_list
+
+        return c_list.weave(ct.evolve(weaver="pure")).evolve(
+            weaver=ct.weaver
+        )
     rank, _ = weave_arrays(na)
     order = np.argsort(rank[: na.capacity], kind="stable")
     weave = [na.nodes[i] for i in order[: na.n]]
@@ -514,6 +522,15 @@ def merge_map_trees(ct1, ct2):
     from ..collections import shared as s
 
     return refresh_map_weave(s.union_nodes(ct1, ct2))
+
+
+def _pure_fleet_fallback(first, cts):
+    """N-way union + pure reweave, for fleets off the device domain."""
+    from ..collections import clist as c_list
+    from ..collections import shared as s
+
+    ct = s.union_nodes_many([first.evolve(weaver="pure")] + cts[1:])
+    return c_list.weave(ct).evolve(weaver=first.weaver)
 
 
 def merge_many_list_trees(cts):
@@ -580,10 +597,11 @@ def merge_many_list_trees(cts):
         # dangling nodes under root, the pure scan does not. Fall back
         # to the pure reweave of the union — same stance as nativew's
         # OutsideDomain path — so every backend converges identically.
-        from ..collections import clist as c_list
+        return _pure_fleet_fallback(first, cts)
 
-        ct = s.union_nodes_many([first.evolve(weaver="pure")] + cts[1:])
-        return c_list.weave(ct).evolve(weaver=first.weaver)
+    if not na.spec_ok:
+        # ids beyond the PackSpec: valid fleet, but no device lanes
+        return _pure_fleet_fallback(first, cts)
 
     rank, _ = weave_arrays(na)
     order = np.argsort(rank[: na.capacity], kind="stable")

@@ -1,0 +1,256 @@
+"""Phase-level TPU timing for the v3 kernel: times progressively longer
+prefixes of the pipeline, so each phase's marginal cost is the
+difference between consecutive rows. Run with --smoke for a quick
+check; full size matches bench.py."""
+
+from __future__ import annotations
+
+import argparse
+import math
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS
+from cause_tpu.weaver.arrays import I32_MAX
+from cause_tpu.weaver.jaxw3 import _shift1
+
+
+def timed(name, fn, *args, reps=3):
+    out = np.asarray(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = np.asarray(fn(*args))
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    print(f"{name:44s} {float(np.median(ts)):9.1f} ms")
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    args_ns = ap.parse_args()
+    if args_ns.smoke:
+        B, n_base, n_div, cap = 8, 800, 100, 1024
+    else:
+        B, n_base, n_div, cap = 1024, 9_000, 1_000, 10_240
+
+    print(f"platform={jax.devices()[0].platform} B={B} cap={cap}")
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=n_base, n_div=n_div, capacity=cap,
+        hide_every=8,
+    )
+    k_max = benchgen.pair_run_budget(batch)
+    print(f"k_max={k_max}")
+    dev = [jax.device_put(batch[k]) for k in LANE_KEYS]
+    N = dev[0].shape[1]
+
+    def stage(upto):
+        """Build a jitted batched program running pipeline stages
+        0..upto, reducing every live intermediate to one scalar."""
+
+        def row(hi, lo, cause_hi, cause_lo, vclass, valid):
+            idx = jnp.arange(N, dtype=jnp.int32)
+            targets = jnp.arange(1, k_max + 1, dtype=jnp.int32)
+            acc = jnp.float32(0)
+
+            order = jnp.lexsort((lo, hi))
+            h, l = hi[order], lo[order]
+            ch, cl = cause_hi[order], cause_lo[order]
+            vc, va = vclass[order], valid[order]
+            if upto == 0:
+                return jnp.sum(h.astype(jnp.float32))
+
+            prev_h, prev_l = _shift1(h, I32_MAX), _shift1(l, I32_MAX)
+            dup = (h == prev_h) & (l == prev_l) & (idx > 0)
+            keep = va & ~dup
+            cum_keep = jnp.cumsum(keep.astype(jnp.int32))
+            kidx = cum_keep - 1
+            is_root = keep & (idx == 0)
+            special = keep & (vc > 0)
+            rel = keep & ~is_root
+            sp_pack = lax.cummax(
+                jnp.where(keep, idx * 2 + special.astype(jnp.int32), -1)
+            )
+            sp_prev = _shift1(sp_pack, -1)
+            prev_kept = sp_prev >> 1
+            prev_kept_special = (sp_prev >= 0) & (sp_prev % 2 == 1)
+            adj = rel & (ch == prev_h) & (cl == prev_l) & (sp_prev >= 0)
+            host_case = adj & ~special & prev_kept_special
+            irregular = rel & (~adj | host_case)
+            if upto == 1:
+                return (jnp.sum(kidx.astype(jnp.float32))
+                        + jnp.sum(irregular.astype(jnp.float32)))
+
+            ir_cum = jnp.cumsum(irregular.astype(jnp.int32))
+            n_irr = ir_cum[-1]
+            q_lane = jnp.searchsorted(
+                ir_cum, targets, side="left").astype(jnp.int32)
+            q_valid = targets <= n_irr
+            q_c = jnp.clip(q_lane, 0, N - 1)
+            q_ch, q_cl = ch[q_c], cl[q_c]
+            q_adj = adj[q_c]
+            q_prev = prev_kept[q_c]
+            q_special = special[q_c]
+            if upto == 2:
+                return jnp.sum(q_lane.astype(jnp.float32))
+
+            steps = max(1, math.ceil(math.log2(max(2, N)))) + 1
+
+            def sbody(_, c):
+                lo_b, hi_b = c
+                mid = (lo_b + hi_b) // 2
+                ms = jnp.clip(mid, 0, N - 1)
+                less = (h[ms] < q_ch) | ((h[ms] == q_ch) & (l[ms] < q_cl))
+                return (jnp.where(less, mid + 1, lo_b),
+                        jnp.where(less, hi_b, mid))
+
+            lo_b, _hi_b = lax.fori_loop(
+                0, steps, sbody,
+                (jnp.zeros(k_max, jnp.int32), jnp.full(k_max, N, jnp.int32)),
+            )
+            pos = jnp.clip(lo_b, 0, N - 1)
+            found = (h[pos] == q_ch) & (l[pos] == q_cl)
+            q_cause = jnp.where(q_adj, q_prev,
+                                jnp.where(found, pos, 0)).astype(jnp.int32)
+            if upto == 3:
+                return jnp.sum(q_cause.astype(jnp.float32))
+
+            back1 = jnp.where(special & adj, prev_kept, idx).astype(jnp.int32)
+            back1 = back1.at[
+                jnp.where(q_valid & q_special, q_lane, N)
+            ].set(q_cause, mode="drop")
+
+            def wcond(c):
+                host, i = c
+                hs = jnp.clip(host, 0, N - 1)
+                return (i < N) & jnp.any(q_valid & ~q_special & special[hs])
+
+            def wbody(c):
+                host, i = c
+                hs = jnp.clip(host, 0, N - 1)
+                step = q_valid & ~q_special & special[hs]
+                return jnp.where(step, back1[hs], host), i + 1
+
+            host_q, _ = lax.while_loop(wcond, wbody, (q_cause, jnp.int32(0)))
+            q_parent = jnp.where(q_special, q_cause, host_q)
+            if upto == 4:
+                return jnp.sum(q_parent.astype(jnp.float32))
+
+            extra = jnp.zeros(N, jnp.int32).at[
+                jnp.where(q_valid, q_parent, N)
+            ].add(1, mode="drop")
+            ec_pack = lax.cummax(
+                jnp.where(keep, idx * 2 + (extra > 0).astype(jnp.int32), -1)
+            )
+            ec_prev = _shift1(ec_pack, -1)
+            prev_kept_contested = (ec_prev >= 0) & (ec_prev % 2 == 1)
+            glued = adj & ~host_case & ~prev_kept_contested
+            run_start = keep & ~glued
+            rs_cum = jnp.cumsum(run_start.astype(jnp.int32))
+            if upto == 5:
+                return jnp.sum(rs_cum.astype(jnp.float32))
+
+            run_id = rs_cum - 1
+            n_runs = rs_cum[-1]
+            n_kept = cum_keep[-1]
+            head_lane = jnp.searchsorted(
+                rs_cum, targets, side="left").astype(jnp.int32)
+            r_valid = targets <= jnp.minimum(n_runs, k_max)
+            head_c = jnp.clip(head_lane, 0, N - 1)
+            if upto == 6:
+                return jnp.sum(head_lane.astype(jnp.float32))
+
+            from cause_tpu.weaver.jaxw import _euler_rank, _link_children
+
+            parent_full = jnp.full(N, -1, jnp.int32).at[
+                jnp.where(q_valid, q_lane, N)
+            ].set(q_parent, mode="drop")
+            h_parent_lane = jnp.where(
+                irregular[head_c], parent_full[head_c],
+                jnp.where(adj[head_c], prev_kept[head_c], -1),
+            )
+            h_parent_lane = jnp.where(
+                r_valid & ~is_root[head_c], h_parent_lane, -1)
+            parent_run = jnp.where(
+                h_parent_lane >= 0,
+                run_id[jnp.clip(h_parent_lane, 0, N - 1)],
+                -1,
+            ).astype(jnp.int32)
+            h_special = special[head_c]
+            h_kidx = kidx[head_c]
+            nxt_kidx = jnp.concatenate([h_kidx[1:], h_kidx[:1]])
+            run_len = jnp.where(
+                r_valid,
+                jnp.where(targets == n_runs, n_kept - h_kidx,
+                          nxt_kidx - h_kidx),
+                0,
+            ).astype(jnp.int32)
+            parent_sort = jnp.where(
+                r_valid & (parent_run >= 0), parent_run, k_max)
+            packed = parent_sort * 2 + (~h_special).astype(jnp.int32)
+            sord = jnp.lexsort((-head_c, packed))
+            fc, ns = _link_children(sord, parent_sort)
+            parent_up = jnp.where(r_valid & (parent_run >= 0), parent_run, -1)
+            base, _ = _euler_rank(fc, ns, parent_up, run_len)
+            if upto == 7:
+                return jnp.sum(base.astype(jnp.float32))
+
+            delta = jnp.where(
+                r_valid,
+                base - jnp.concatenate(
+                    [jnp.zeros((1,), base.dtype), base[:-1]]),
+                0,
+            )
+            delta_n = jnp.zeros(N, jnp.int32).at[
+                jnp.where(r_valid, head_c, N)
+            ].set(delta.astype(jnp.int32), mode="drop")
+            base_ff = jnp.cumsum(delta_n)
+            ffh = lax.cummax(jnp.where(run_start, kidx, -1))
+            rank = jnp.where(keep, base_ff + (kidx - ffh), N).astype(jnp.int32)
+            if upto == 8:
+                return jnp.sum(rank.astype(jnp.float32))
+            return acc
+
+        @jax.jit
+        def prog(*a):
+            return jnp.sum(jax.vmap(row)(*a))
+
+        return prog
+
+    names = [
+        "0 sort",
+        "1 + flags/scans (cum_keep, sp_pack, adj)",
+        "2 + irregular compaction (searchsorted)",
+        "3 + cause binary search",
+        "4 + back1 + host-jump while",
+        "5 + contested scatter + glue + rs_cum",
+        "6 + head compaction (searchsorted)",
+        "7 + parents/siblings/euler at K",
+        "8 + delta-cumsum rank expansion",
+    ]
+    for i, nm in enumerate(names):
+        timed(nm, stage(i), *dev)
+
+    from cause_tpu.weaver.jaxw3 import batched_merge_weave_v3
+
+    @jax.jit
+    def whole(*a):
+        o, r, v, c, ovf = batched_merge_weave_v3(*a, k_max=k_max)
+        return (jnp.sum(r.astype(jnp.float32))
+                + jnp.sum(v.astype(jnp.float32))
+                + jnp.sum(o.astype(jnp.float32))
+                + jnp.sum(c.astype(jnp.float32))
+                + jnp.sum(ovf.astype(jnp.float32)))
+
+    timed("9 WHOLE v3 (incl. visibility)", whole, *dev)
+
+
+if __name__ == "__main__":
+    main()

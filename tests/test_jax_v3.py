@@ -1,0 +1,101 @@
+"""Parity suite for the v3 sparse-irregular kernel: v1 (the direct
+device port of the pure semantics, itself fuzz-verified against the
+pure oracle) is the reference; v3 must reproduce its ranks, visibility,
+order, and conflict flags exactly, and flag overflow exactly when the
+run budget is exceeded."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import cause_tpu as c
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS
+from cause_tpu.collections import clist as c_list
+from cause_tpu.ids import new_site_id
+from cause_tpu.weaver import jaxw, jaxw3
+from cause_tpu.weaver.arrays import NodeArrays
+
+from test_list import rand_node
+
+
+def v1_v3_match(args, k_max):
+    o1, r1, v1, c1 = jaxw.merge_weave_kernel(*args)
+    o3, r3, v3, c3, ovf = jaxw3.merge_weave_kernel_v3(*args, k_max=k_max)
+    assert not bool(ovf)
+    assert np.array_equal(np.asarray(o1), np.asarray(o3))
+    assert np.array_equal(np.asarray(r1), np.asarray(r3))
+    assert np.array_equal(np.asarray(v1), np.asarray(v3))
+    assert bool(c1) == bool(c3)
+
+
+@pytest.mark.parametrize(
+    "nb,nd,cap,he",
+    [(40, 12, 64, 3), (100, 40, 256, 5), (5, 3, 16, 2), (0, 4, 16, 0),
+     (31, 1, 64, 1)],
+)
+def test_v3_pair_merge_parity(nb, nd, cap, he):
+    row = benchgen.divergent_pair_lanes(
+        n_base=nb, n_div=nd, capacity=cap, hide_every=he
+    )
+    args = tuple(jnp.asarray(row[k]) for k in LANE_KEYS)
+    v1_v3_match(args, benchgen.estimate_pair_runs(row) + 8)
+
+
+def test_v3_fuzz_tree_parity():
+    """Random trees with chained specials (hide -> h.show -> hide ...),
+    multi-site interleaving, and dangling-adjacent shapes."""
+    rng = random.Random(0xF00D)
+    for _ in range(25):
+        cl = c.clist(*"ab")
+        sites = [new_site_id() for _ in range(3)]
+        for _ in range(rng.randrange(3, 25)):
+            cl = cl.insert(rand_node(rng, cl, site_id=rng.choice(sites)))
+        na = NodeArrays.from_nodes_map(cl.ct.nodes)
+        hi, lo = na.id_lanes()
+        chi, clo = na.cause_lanes()
+        args = tuple(
+            jnp.asarray(x)
+            for x in (hi, lo, chi, clo, na.vclass, na.valid)
+        )
+        v1_v3_match(args, max(8, na.capacity))
+
+
+def test_v3_batched_parity_and_overflow():
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=6, n_base=40, n_div=12, capacity=64, hide_every=3
+    )
+    k_max = benchgen.pair_run_budget(batch)
+    bargs = tuple(jnp.asarray(batch[k]) for k in LANE_KEYS)
+    o1, r1, v1, c1 = jaxw.batched_merge_weave(*bargs)
+    o3, r3, v3, c3, ovf = jaxw3.batched_merge_weave_v3(*bargs, k_max=k_max)
+    assert not np.asarray(ovf).any()
+    assert np.array_equal(np.asarray(r1), np.asarray(r3))
+    assert np.array_equal(np.asarray(v1), np.asarray(v3))
+    assert np.array_equal(np.asarray(o1), np.asarray(o3))
+    # a busted budget must flag, not silently corrupt
+    *_, ovf = jaxw3.batched_merge_weave_v3(*bargs, k_max=4)
+    assert np.asarray(ovf).all()
+
+
+def test_v3_conflict_flag():
+    """Two lanes sharing an id with different bodies raise the conflict
+    flag through v3 exactly as v1."""
+    row = benchgen.divergent_pair_lanes(
+        n_base=10, n_div=4, capacity=32, hide_every=0
+    )
+    # corrupt: give the second copy of a shared base node a new vclass
+    vc = row["vc"].copy()
+    half = len(vc) // 2
+    vc[half + 5] = 1  # shared base node, differing body
+    args = tuple(
+        jnp.asarray(x)
+        for x in (row["hi"], row["lo"], row["chi"], row["clo"], vc,
+                  row["valid"])
+    )
+    *_, c1 = jaxw.merge_weave_kernel(*args)
+    _, _, _, c3, _ = jaxw3.merge_weave_kernel_v3(*args, k_max=64)
+    assert bool(c1) and bool(c3)

@@ -169,6 +169,32 @@ def test_native_handles_out_of_packspec_ids():
     with pytest.raises(OverflowError):
         na.cause_lanes()
 
+    # ...and the jax backend's FULL REBUILD falls back to pure instead
+    # of raising, so every backend weaves the same trees
+    from cause_tpu.weaver import jaxw
+
+    jx = c.clist("a", weaver="jax").insert(
+        ((2, cl.get_site_id(), 10_000), ROOT_ID, "x")
+    )
+    rebuilt = jaxw.refresh_list_weave(jx.ct)
+    assert rebuilt.weave == pure_list_weave(jx.ct)
+    assert rebuilt.weaver == "jax"
+
+    # cause-only overflow: node ids fit, one cause does not
+    from cause_tpu.ids import ROOT_ID as _root
+
+    base = c.clist("a", weaver="jax")
+    nid = (base.get_ts() + 1, base.get_site_id(), 0)
+    ok_node = (nid, _root, "y")
+    fleet_tree = base.insert(ok_node).ct
+    ghost_cause_nodes = dict(fleet_tree.nodes)
+    ghost_cause_nodes[(nid[0] + 1, nid[1], 0)] = ((1, "zz_ghost______", 20_000), "z")
+    overflowed = fleet_tree.evolve(nodes=ghost_cause_nodes)
+    na2 = NodeArrays.from_nodes_map(overflowed.nodes)
+    assert not na2.spec_ok
+    with pytest.raises(OverflowError):
+        na2.id_lanes()  # cause-only overflow must not slip through
+
 
 def test_cause_lanes_spec_mismatch_raises():
     """cause_lanes are packed at marshal time; asking for a different
