@@ -194,11 +194,14 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
                           n_base: int = 800, n_div: int = 100,
                           cap: int = 1024, reps: int = 3,
                           k_max: Optional[int] = None,
+                          kernel: str = "v3",
                           profile_dir: Optional[str] = None) -> dict:
     """Batched device merge of divergent replicas (north-star shape;
     sizes here are CLI defaults — bench.py runs the full 1024x10k).
-    ``k_max``: None = workload-derived run budget (the compressed v2
-    kernel), 0 = the uncompressed v1 kernel."""
+    ``k_max``: None = workload-derived run budget, 0 = the uncompressed
+    v1 kernel. ``kernel`` picks the compressed kernel ("v3"
+    sparse-irregular, the same default bench.py measures, or "v2"
+    chain-compressed)."""
     import numpy as _np
 
     import jax
@@ -214,7 +217,9 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
         k_max = benchgen.pair_run_budget(batch)
 
     def step():
-        out = _np.asarray(merge_wave_scalar(*args, k_max=k_max))
+        out = _np.asarray(
+            merge_wave_scalar(*args, k_max=k_max, kernel=kernel)
+        )
         if k_max and out.shape and out[1]:
             raise RuntimeError("run budget overflow — raise k_max")
         return out
@@ -231,7 +236,7 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
         "config": 5,
         "metric": f"batched merge, {n_replicas} pairs x "
                   f"{1 + n_base + n_div}-node lists",
-        "weaver": "jax" if k_max else "jax-v1",
+        "weaver": f"jax-{kernel}" if k_max else "jax-v1",
         "value": round(secs * 1000.0, 3),
         "unit": "ms",
     }

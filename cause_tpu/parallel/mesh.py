@@ -75,14 +75,20 @@ def replica_digest(hi_sorted, lo_sorted, rank, visible):
 
 
 @lru_cache(maxsize=8)
-def _sharded_step(mesh: Mesh, k_max: int):
+def _sharded_step(mesh: Mesh, k_max: int, kernel: str = "v3"):
     """The jitted sharded merge step for one mesh (cached so repeat
     merge waves hit the jit cache instead of re-tracing). ``k_max`` > 0
-    runs the chain-compressed kernel with that run budget (overflowed
-    rows are psum-counted fleet-wide); 0 runs the uncompressed kernel."""
+    runs a compressed kernel — ``kernel`` picks the sparse-irregular
+    "v3" (default) or chain-compressed "v2" — with that run budget
+    (overflowed rows are psum-counted fleet-wide); 0 runs the
+    uncompressed kernel."""
     axis = mesh.axis_names[0]
     sharded = P(axis)
     replicated = P()
+    if kernel == "v3":
+        from ..weaver.jaxw3 import merge_weave_kernel_v3 as _compressed
+    else:
+        _compressed = merge_weave_kernel_v2
 
     @partial(
         _shard_map,
@@ -94,7 +100,7 @@ def _sharded_step(mesh: Mesh, k_max: int):
     def step(hi, lo, chi, clo, vc, va):
         if k_max > 0:
             order, rank, visible, conflict, overflow = jax.vmap(
-                lambda *r: merge_weave_kernel_v2(*r, k_max)
+                lambda *r: _compressed(*r, k_max)
             )(hi, lo, chi, clo, vc, va)
             n_overflow = lax.psum(jnp.sum(overflow.astype(jnp.int32)), axis)
         else:
@@ -114,16 +120,19 @@ def _sharded_step(mesh: Mesh, k_max: int):
 
 
 def sharded_merge_weave(mesh: Mesh, hi, lo, cause_hi, cause_lo, vclass, valid,
-                        k_max: int = 0):
+                        k_max: int = 0, kernel: str = "v3"):
     """Run the batched merge+weave with the replica axis sharded over
     the mesh. Returns per-replica ``(order, rank, visible, digest)``
     (sharded) plus fleet-level ``(total_visible, n_conflicts,
     n_overflow)`` reduced with psum over the mesh axis. ``k_max`` > 0
-    selects the chain-compressed kernel with that per-replica run
+    selects a compressed kernel (``kernel``: "v3" sparse-irregular,
+    the default, or "v2" chain-compressed) with that per-replica run
     budget; rows counted in ``n_overflow`` carry invalid ranks and the
     caller should rerun with ``k_max=0`` (or a bigger budget).
 
     The batch dimension must be divisible by the mesh size.
     """
-    return _sharded_step(mesh, k_max)(hi, lo, cause_hi, cause_lo, vclass,
-                                      valid)
+    # normalize the cache key: kernel is only consulted when k_max > 0,
+    # so k_max=0 calls must not mint per-kernel duplicate programs
+    step = _sharded_step(mesh, k_max, kernel if k_max > 0 else "v1")
+    return step(hi, lo, cause_hi, cause_lo, vclass, valid)

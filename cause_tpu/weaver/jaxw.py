@@ -467,10 +467,28 @@ def _run_budget(capacity: int) -> int:
 
 def weave_arrays(na: NodeArrays) -> Tuple[np.ndarray, np.ndarray]:
     """Run the device linearization for one tree; returns host-side
-    ``(rank, visible)`` numpy arrays. Uses the chain-compressed kernel
-    when the tree's run count fits the budget (computed host-side, so
-    a branchy tree never pays for a doomed v2 dispatch)."""
+    ``(rank, visible)`` numpy arrays. Prefers the sparse-irregular v3
+    merge kernel (single-tree inputs are just an already-sorted,
+    duplicate-free merge), falls back to the chain-compressed v2 and
+    then the uncompressed v1 when the run budget overflows (the
+    estimate is computed host-side, so a branchy tree never pays for a
+    doomed compressed dispatch)."""
+    from .jaxw3 import merge_weave_kernel_v3_jit
+
     hi, lo = na.id_lanes()
+    k_max = _run_budget(na.capacity)
+    fits = estimate_runs(na.cause_idx, na.vclass, na.valid) <= k_max
+    if fits:
+        chi, clo = na.cause_lanes()
+        _, rank, visible, _, overflow = merge_weave_kernel_v3_jit(
+            jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(chi),
+            jnp.asarray(clo), jnp.asarray(na.vclass),
+            jnp.asarray(na.valid), k_max=k_max,
+        )
+        if not bool(overflow):
+            # v3 ranks are per *sorted* lane, but single-tree lanes are
+            # already id-sorted, so the identity order carries over
+            return np.asarray(rank), np.asarray(visible)
     args = (
         jnp.asarray(hi),
         jnp.asarray(lo),
@@ -478,10 +496,9 @@ def weave_arrays(na: NodeArrays) -> Tuple[np.ndarray, np.ndarray]:
         jnp.asarray(na.vclass),
         jnp.asarray(na.valid),
     )
-    k_max = _run_budget(na.capacity)
-    if estimate_runs(na.cause_idx, na.vclass, na.valid) <= k_max:
+    if fits:
         rank, visible, overflow = _linearize_v2_jit(*args, k_max=k_max)
-        if not bool(overflow):  # belt and braces: estimate is exact
+        if not bool(overflow):
             return np.asarray(rank), np.asarray(visible)
     rank, visible = _linearize_jit(*args)
     return np.asarray(rank), np.asarray(visible)

@@ -143,9 +143,12 @@ def merge_weave_kernel_v3(hi, lo, cause_hi, cause_lo, vclass, valid,
         less = (h[ms] < q_ch) | ((h[ms] == q_ch) & (l[ms] < q_cl))
         return jnp.where(less, mid + 1, lo_b), jnp.where(less, hi_b, mid)
 
+    # derive the carries from varying data (zeros_like, not zeros) so
+    # the binary search traces under shard_map, where a replicated
+    # constant carry would clash with the varying output axis
     lo_b, hi_b = lax.fori_loop(
         0, steps, sbody,
-        (jnp.zeros(k_max, jnp.int32), jnp.full(k_max, N, jnp.int32)),
+        (jnp.zeros_like(q_lane), jnp.full_like(q_lane, N)),
     )
     pos = jnp.clip(lo_b, 0, N - 1)
     found = (h[pos] == q_ch) & (l[pos] == q_cl)
@@ -298,6 +301,11 @@ def merge_weave_kernel_v3(hi, lo, cause_hi, cause_lo, vclass, valid,
         keep & (vc == 0) & ~is_root & ~(killed_inrun | killed_tail)
     )
     return order, rank, visible, conflict, overflow
+
+
+merge_weave_kernel_v3_jit = jax.jit(
+    merge_weave_kernel_v3, static_argnames="k_max"
+)
 
 
 @partial(jax.jit, static_argnames="k_max")

@@ -1,0 +1,95 @@
+"""API-level merge benchmark: the end-to-end cost of
+``CausalList.merge`` at 10k nodes, per backend, with the jax path
+split into host-marshal vs device-kernel so the marshal overhead is
+measured honestly (kernel-level benchmarks bypass it via benchgen).
+
+Prints one JSON line per backend plus the breakdown.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def build_pair(n_base: int, n_div: int, weaver: str):
+    import cause_tpu as c
+    from cause_tpu.collections.clist import CausalList
+    from cause_tpu.ids import new_site_id
+
+    base = c.clist(weaver=weaver).extend(["x"] * n_base)
+    a = CausalList(base.ct.evolve(site_id=new_site_id()))
+    b = CausalList(base.ct.evolve(site_id=new_site_id()))
+    a = a.extend([f"a{i}" for i in range(n_div)])
+    b = b.extend([f"b{i}" for i in range(n_div)])
+    return a, b
+
+
+def timed(fn, reps=3):
+    fn()  # warm (compiles, caches)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append((time.perf_counter() - t0) * 1000)
+    return float(np.median(ts))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--n-base", type=int, default=9_000)
+    ap.add_argument("--n-div", type=int, default=1_000)
+    ap.add_argument("--cpu", action="store_true")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    import jax
+
+    platform = None
+
+    for weaver in ("pure", "native", "jax"):
+        if weaver == "jax":
+            platform = jax.devices()[0].platform
+        a, b = build_pair(args.n_base, args.n_div, weaver)
+        p50 = timed(lambda: a.merge(b))
+        print(json.dumps({
+            "metric": f"CausalList.merge {args.n_base}+{args.n_div} nodes",
+            "weaver": weaver,
+            "value": round(p50, 1),
+            "unit": "ms",
+        }), flush=True)
+
+        if weaver == "jax":
+            from cause_tpu.collections import shared as s
+            from cause_tpu.weaver import jaxw
+            from cause_tpu.weaver.arrays import NodeArrays
+
+            union = s.union_nodes(a.ct, b.ct)
+            t_union = timed(lambda: s.union_nodes(a.ct, b.ct))
+            t_marshal = timed(lambda: NodeArrays.from_nodes_map(union.nodes))
+            na = NodeArrays.from_nodes_map(union.nodes)
+            t_kernel = timed(lambda: jaxw.weave_arrays(na))
+
+            def rebuild():
+                rank, _ = jaxw.weave_arrays(na)
+                order = np.argsort(rank[: na.capacity], kind="stable")
+                return [na.nodes[i] for i in order[: na.n]]
+
+            t_rebuild = timed(rebuild)
+            print(json.dumps({
+                "metric": "jax merge breakdown",
+                "host_union_ms": round(t_union, 1),
+                "host_marshal_ms": round(t_marshal, 1),
+                "device_weave_ms": round(t_kernel, 1),
+                "weave_plus_rebuild_ms": round(t_rebuild, 1),
+                "platform": platform,
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

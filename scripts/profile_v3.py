@@ -1,7 +1,14 @@
 """Phase-level TPU timing for the v3 kernel: times progressively longer
 prefixes of the pipeline, so each phase's marginal cost is the
 difference between consecutive rows. Run with --smoke for a quick
-check; full size matches bench.py."""
+check; full size matches bench.py.
+
+NOTE: the stage bodies are a hand-inlined SNAPSHOT of
+``weaver/jaxw3.py`` (prefix timing needs the intermediate values a
+composed kernel call hides). After editing the kernel, re-sync the
+matching lines here before trusting phase timings — the final "WHOLE"
+row calls the real kernel, so a drift shows up as prefix rows that no
+longer sum to it."""
 
 from __future__ import annotations
 
@@ -92,7 +99,7 @@ def main():
             n_irr = ir_cum[-1]
             q_lane = jnp.searchsorted(
                 ir_cum, targets, side="left").astype(jnp.int32)
-            q_valid = targets <= n_irr
+            q_valid = targets <= jnp.minimum(n_irr, k_max)
             q_c = jnp.clip(q_lane, 0, N - 1)
             q_ch, q_cl = ch[q_c], cl[q_c]
             q_adj = adj[q_c]
@@ -113,7 +120,7 @@ def main():
 
             lo_b, _hi_b = lax.fori_loop(
                 0, steps, sbody,
-                (jnp.zeros(k_max, jnp.int32), jnp.full(k_max, N, jnp.int32)),
+                (jnp.zeros_like(q_lane), jnp.full_like(q_lane, N)),
             )
             pos = jnp.clip(lo_b, 0, N - 1)
             found = (h[pos] == q_ch) & (l[pos] == q_cl)
