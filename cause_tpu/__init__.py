@@ -21,8 +21,10 @@ from .cbase import (
     new_causal_base,
     uuid_to_ref,
 )
+from .collections.ccounter import CausalCounter, new_causal_counter
 from .collections.clist import CausalList, new_causal_list
 from .collections.cmap import CausalMap, new_causal_map
+from .collections.cset import CausalSet, new_causal_set
 from .collections.shared import CausalError, CausalTree
 from .ids import (
     H_HIDE,
@@ -98,6 +100,8 @@ def get_site_id(causal):
 # Causal collection types are convergent and EDN-like (core.cljc:41-42).
 clist = new_causal_list
 cmap = new_causal_map
+cset = new_causal_set
+ccounter = new_causal_counter
 
 
 # Causal collection functions (core.cljc:45-50).
@@ -180,8 +184,14 @@ __all__ = [
     "node",
     "clist",
     "cmap",
+    "cset",
+    "ccounter",
+    "CausalSet",
+    "CausalCounter",
     "new_causal_list",
     "new_causal_map",
+    "new_causal_set",
+    "new_causal_counter",
     "new_causal_base",
     "insert",
     "append",

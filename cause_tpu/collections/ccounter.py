@@ -1,0 +1,168 @@
+"""CausalCounter — a convergent counter CRDT on the causal tree.
+
+A reference roadmap wish ("∆ Implement CausalCounter",
+/root/reference/README.md:249) the reference never built. The tree is
+a list tree whose node values are numeric deltas; the rendered value
+is the sum of visible deltas. Addition commutes, so any merge order
+converges; a delta can be undone by tombstoning its node (the same
+id-caused hide the other collections use), giving the counter undo
+semantics no ordinary PN-counter has.
+"""
+
+from __future__ import annotations
+
+from numbers import Number
+from typing import Optional
+
+from ..ids import HIDE
+from . import clist as c_list
+from . import shared as s
+from .shared import CausalTree
+
+__all__ = [
+    "COUNTER_TYPE", "CausalCounter", "new_causal_counter",
+    "new_causal_tree",
+]
+
+COUNTER_TYPE = "counter"
+
+
+def new_causal_tree(weaver: str = "pure") -> CausalTree:
+    """A counter tree is a list tree with its own type tag."""
+    return c_list.new_causal_tree(weaver).evolve(type=COUNTER_TYPE)
+
+
+def counter_value(ct: CausalTree):
+    return sum(
+        n[2] for n in c_list.causal_list_to_list(ct)
+        if isinstance(n[2], Number)
+    )
+
+
+class CausalCounter:
+    """Immutable CausalCounter handle; mutating-looking methods return
+    a new counter."""
+
+    __slots__ = ("ct",)
+
+    def __init__(self, ct: CausalTree):
+        object.__setattr__(self, "ct", ct)
+
+    def __setattr__(self, *a):
+        raise AttributeError("CausalCounter is immutable")
+
+    # -- CausalMeta --
+    def get_uuid(self) -> str:
+        return self.ct.uuid
+
+    def get_ts(self) -> int:
+        return self.ct.lamport_ts
+
+    def get_site_id(self) -> str:
+        return self.ct.site_id
+
+    # -- CausalTree protocol --
+    def get_weave(self):
+        return self.ct.weave
+
+    def get_nodes(self):
+        return self.ct.nodes
+
+    def insert(self, node, more_nodes=None) -> "CausalCounter":
+        return CausalCounter(
+            s.insert(c_list.weave, self.ct, node, more_nodes)
+        )
+
+    def append(self, cause, value) -> "CausalCounter":
+        return CausalCounter(s.append(c_list.weave, self.ct, cause, value))
+
+    def weft(self, ids_to_cut_yarns) -> "CausalCounter":
+        return CausalCounter(
+            s.weft(c_list.weave,
+                   lambda: new_causal_tree(self.ct.weaver),
+                   self.ct, ids_to_cut_yarns)
+        )
+
+    def merge(self, other: "CausalCounter") -> "CausalCounter":
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return CausalCounter(jaxw.merge_list_trees(self.ct, other.ct))
+        if self.ct.weaver == "native":
+            from ..weaver import nativew
+
+            return CausalCounter(nativew.merge_trees(self.ct, other.ct))
+        return CausalCounter(s.merge_trees(c_list.weave, self.ct, other.ct))
+
+    def merge_many(self, others) -> "CausalCounter":
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return CausalCounter(
+                jaxw.merge_many_list_trees(
+                    [self.ct] + [o.ct for o in others]
+                )
+            )
+        ct = s.union_nodes_many([self.ct] + [o.ct for o in others])
+        return CausalCounter(c_list.weave(ct))
+
+    # -- CausalTo --
+    def causal_to_edn(self, opts: Optional[dict] = None):
+        return counter_value(self.ct)
+
+    # -- counter interop --
+    def increment(self, n=1) -> "CausalCounter":
+        """Record a delta (any number, so decrement = increment(-n))."""
+        if not isinstance(n, Number) or isinstance(n, bool):
+            raise s.CausalError(
+                "Counter deltas must be numbers.",
+                {"causes": {"not-a-number"}, "value": n},
+            )
+        return CausalCounter(c_list.conj_(self.ct, n))
+
+    def decrement(self, n=1) -> "CausalCounter":
+        return self.increment(-n)
+
+    def undo_delta(self, node_id) -> "CausalCounter":
+        """Tombstone one recorded delta by node id."""
+        return self.append(node_id, HIDE)
+
+    def value(self):
+        return counter_value(self.ct)
+
+    def deltas(self):
+        """The visible delta nodes in weave order (for blame/undo)."""
+        return [
+            n for n in c_list.causal_list_to_list(self.ct)
+            if isinstance(n[2], Number)
+        ]
+
+    def __int__(self) -> int:
+        return int(counter_value(self.ct))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, CausalCounter) and self.ct == other.ct
+
+    def __hash__(self) -> int:
+        return hash((self.ct.uuid, self.ct.lamport_ts, self.ct.site_id,
+                     tuple(sorted(self.ct.nodes))))
+
+    def __repr__(self) -> str:
+        return f"#causal/counter {counter_value(self.ct)!r}"
+
+    def __str__(self) -> str:
+        return str(counter_value(self.ct))
+
+    # -- IObj/IMeta analogue --
+    def with_meta(self, m) -> "CausalCounter":
+        return CausalCounter(self.ct.evolve(meta=m))
+
+    def meta(self):
+        return self.ct.meta
+
+
+def new_causal_counter(start=0, weaver: str = "pure") -> CausalCounter:
+    cc = CausalCounter(new_causal_tree(weaver))
+    if start:
+        cc = cc.increment(start)
+    return cc

@@ -1,0 +1,180 @@
+"""CausalSet — an observed-remove set CRDT on the causal tree.
+
+A reference roadmap wish ("∪ Implement CausalSet",
+/root/reference/README.md:250) the reference never built; cause_tpu
+provides it on the existing machinery: the tree IS a list tree (chain
+of add-nodes under the weave tail, tombstones as hide specials), so
+every backend — pure scan, native C++, and the batched TPU kernels —
+accelerates it with zero new kernel code.
+
+Semantics (classic OR-set): ``add`` appends a node carrying the
+element; ``discard`` tombstones every *observed* add-node of the
+element. A concurrent add at another site is unobserved by the remover,
+so it survives the merge — add wins, the standard OR-set resolution.
+Rendered value: the distinct visible elements.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..ids import HIDE
+from . import clist as c_list
+from . import shared as s
+from .shared import CausalTree
+
+__all__ = ["SET_TYPE", "CausalSet", "new_causal_set", "new_causal_tree"]
+
+SET_TYPE = "set"
+
+
+def new_causal_tree(weaver: str = "pure") -> CausalTree:
+    """A set tree is a list tree with its own type tag."""
+    return c_list.new_causal_tree(weaver).evolve(type=SET_TYPE)
+
+
+def visible_nodes_by_value(ct: CausalTree) -> dict:
+    """{element -> [visible nodes carrying it]} in weave order."""
+    out: dict = {}
+    for node in c_list.causal_list_to_list(ct):
+        out.setdefault(node[2], []).append(node)
+    return out
+
+
+def causal_set_to_edn(ct: CausalTree, opts: Optional[dict] = None) -> set:
+    return {
+        s.causal_to_edn(v, opts) for v in visible_nodes_by_value(ct)
+    }
+
+
+class CausalSet:
+    """Immutable CausalSet handle. ``len``/iteration cover the distinct
+    visible elements; all mutating-looking methods return a new set."""
+
+    __slots__ = ("ct",)
+
+    def __init__(self, ct: CausalTree):
+        object.__setattr__(self, "ct", ct)
+
+    def __setattr__(self, *a):
+        raise AttributeError("CausalSet is immutable")
+
+    # -- CausalMeta --
+    def get_uuid(self) -> str:
+        return self.ct.uuid
+
+    def get_ts(self) -> int:
+        return self.ct.lamport_ts
+
+    def get_site_id(self) -> str:
+        return self.ct.site_id
+
+    # -- CausalTree protocol --
+    def get_weave(self):
+        return self.ct.weave
+
+    def get_nodes(self):
+        return self.ct.nodes
+
+    def insert(self, node, more_nodes=None) -> "CausalSet":
+        return CausalSet(s.insert(c_list.weave, self.ct, node, more_nodes))
+
+    def append(self, cause, value) -> "CausalSet":
+        return CausalSet(s.append(c_list.weave, self.ct, cause, value))
+
+    def weft(self, ids_to_cut_yarns) -> "CausalSet":
+        return CausalSet(
+            s.weft(c_list.weave,
+                   lambda: new_causal_tree(self.ct.weaver),
+                   self.ct, ids_to_cut_yarns)
+        )
+
+    def merge(self, other: "CausalSet") -> "CausalSet":
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return CausalSet(jaxw.merge_list_trees(self.ct, other.ct))
+        if self.ct.weaver == "native":
+            from ..weaver import nativew
+
+            return CausalSet(nativew.merge_trees(self.ct, other.ct))
+        return CausalSet(s.merge_trees(c_list.weave, self.ct, other.ct))
+
+    def merge_many(self, others) -> "CausalSet":
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return CausalSet(
+                jaxw.merge_many_list_trees(
+                    [self.ct] + [o.ct for o in others]
+                )
+            )
+        ct = s.union_nodes_many([self.ct] + [o.ct for o in others])
+        return CausalSet(c_list.weave(ct))
+
+    # -- CausalTo --
+    def causal_to_edn(self, opts: Optional[dict] = None) -> set:
+        return causal_set_to_edn(self.ct, opts)
+
+    # -- set interop --
+    def add(self, value) -> "CausalSet":
+        """Add an element. ALWAYS mints a fresh add-node, even when the
+        element is already visible — the node is the OR-set's unique
+        tag, and it is what lets this add survive a concurrent remove
+        (a remove only covers the adds it observed). Skipping
+        already-present values (the LWW map's assoc stance) would
+        silently drop that protection."""
+        return CausalSet(c_list.conj_(self.ct, value))
+
+    def discard(self, value) -> "CausalSet":
+        """Tombstone every *observed* add of the element (OR-set
+        remove); a no-op when absent. Concurrent unobserved adds
+        survive a later merge — add wins."""
+        nodes = visible_nodes_by_value(self.ct).get(value, [])
+        ct = self.ct
+        for node in nodes:
+            ct = s.append(c_list.weave, ct, node[0], HIDE)
+        return CausalSet(ct) if nodes else self
+
+    def empty(self) -> "CausalSet":
+        return CausalSet(
+            new_causal_tree(self.ct.weaver).evolve(
+                site_id=self.ct.site_id, uuid=self.ct.uuid
+            )
+        )
+
+    def __contains__(self, value) -> bool:
+        return value in visible_nodes_by_value(self.ct)
+
+    def __len__(self) -> int:
+        return len(visible_nodes_by_value(self.ct))
+
+    def __iter__(self):
+        return iter(visible_nodes_by_value(self.ct))
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, CausalSet) and self.ct == other.ct
+
+    def __hash__(self) -> int:
+        return hash((self.ct.uuid, self.ct.lamport_ts, self.ct.site_id,
+                     tuple(sorted(self.ct.nodes))))
+
+    def __repr__(self) -> str:
+        return f"#causal/set {causal_set_to_edn(self.ct)!r}"
+
+    def __str__(self) -> str:
+        return str(causal_set_to_edn(self.ct))
+
+    # -- IObj/IMeta analogue --
+    def with_meta(self, m) -> "CausalSet":
+        return CausalSet(self.ct.evolve(meta=m))
+
+    def meta(self):
+        return self.ct.meta
+
+
+def new_causal_set(*items, weaver: str = "pure") -> CausalSet:
+    cs = CausalSet(new_causal_tree(weaver))
+    for v in items:
+        cs = cs.add(v)
+    return cs

@@ -41,11 +41,15 @@ import json
 from typing import Any, Optional
 
 from .cbase import CB, CausalBase, Ref
+from .collections import ccounter as c_counter
 from .collections import clist as c_list
 from .collections import cmap as c_map
+from .collections import cset as c_set
 from .collections import shared as s
+from .collections.ccounter import CausalCounter
 from .collections.clist import CausalList
 from .collections.cmap import CausalMap
+from .collections.cset import CausalSet
 from .collections.shared import CausalTree
 from .ids import Keyword, Special, is_id
 
@@ -100,6 +104,11 @@ def _decode_tree(d: dict) -> CausalTree:
         fresh, weave_fn = c_list.new_causal_tree(d["weaver"]), c_list.weave
     elif kind == s.MAP_TYPE:
         fresh, weave_fn = c_map.new_causal_tree(d["weaver"]), c_map.weave
+    elif kind == c_set.SET_TYPE:
+        fresh, weave_fn = c_set.new_causal_tree(d["weaver"]), c_list.weave
+    elif kind == c_counter.COUNTER_TYPE:
+        fresh, weave_fn = (c_counter.new_causal_tree(d["weaver"]),
+                           c_list.weave)
     else:
         raise s.CausalError("unknown causal tag", {"tag": kind})
     nodes.update(fresh.nodes)  # the seeded root sentinel (list trees)
@@ -161,7 +170,7 @@ def to_data(x) -> Any:
         return {"~s": x.name}
     if isinstance(x, Ref):
         return {"~r": x.uuid}
-    if isinstance(x, CausalList) or isinstance(x, CausalMap):
+    if isinstance(x, (CausalList, CausalMap, CausalSet, CausalCounter)):
         return _encode_tree(x.ct)
     if isinstance(x, CausalTree):
         return _encode_tree(x)
@@ -213,7 +222,13 @@ def from_data(d) -> Any:
             if d["~causal"] == "base":
                 return _decode_base(d)
             ct = _decode_tree(d)
-            return CausalList(ct) if ct.type == s.LIST_TYPE else CausalMap(ct)
+            handle = {
+                s.LIST_TYPE: CausalList,
+                s.MAP_TYPE: CausalMap,
+                c_set.SET_TYPE: CausalSet,
+                c_counter.COUNTER_TYPE: CausalCounter,
+            }[ct.type]
+            return handle(ct)
     raise s.CausalError("undecodable data", {"data": type(d).__name__})
 
 

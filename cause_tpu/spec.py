@@ -83,7 +83,10 @@ def explain_tree(ct) -> List[str]:
     runnable equivalent of ``s/explain ::causal-tree``."""
     problems: List[str] = []
 
-    if ct.type not in (s.LIST_TYPE, s.MAP_TYPE):
+    from .collections.ccounter import COUNTER_TYPE
+    from .collections.cset import SET_TYPE
+
+    if ct.type not in (s.LIST_TYPE, s.MAP_TYPE, SET_TYPE, COUNTER_TYPE):
         problems.append(f"unknown tree type {ct.type!r}")
         return problems
     if not isinstance(ct.lamport_ts, int) or ct.lamport_ts < 0:
@@ -93,7 +96,9 @@ def explain_tree(ct) -> List[str]:
     if not valid_site_id(ct.site_id):
         problems.append(f"bad site-id {ct.site_id!r}")
 
-    is_list = ct.type == s.LIST_TYPE
+    # set/counter trees are list-shaped (root sentinel, id causes,
+    # flat list weave) — they share every list invariant
+    is_list = ct.type in (s.LIST_TYPE, SET_TYPE, COUNTER_TYPE)
 
     # ---- canonical store
     for nid, body in ct.nodes.items():

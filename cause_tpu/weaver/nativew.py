@@ -93,9 +93,11 @@ def refresh_map_weave(ct):
 def refresh_weave(ct):
     from ..collections import shared as s
 
-    if ct.type == s.LIST_TYPE:
-        return refresh_list_weave(ct)
-    return refresh_map_weave(ct)
+    # only map trees carry the per-key weave dict; every other type
+    # (list, and the list-shaped set/counter) uses the flat list weave
+    if ct.type == s.MAP_TYPE:
+        return refresh_map_weave(ct)
+    return refresh_list_weave(ct)
 
 
 def merge_trees(ct1, ct2):

@@ -81,6 +81,49 @@ def test_v3_batched_parity_and_overflow():
     assert np.asarray(ovf).all()
 
 
+def test_v3_hypothesis_random_interactions():
+    """Property: any tree reachable through the public API (random
+    conj/insert/hide interleavings across sites) linearizes identically
+    under v3 and v1. Complements the fixed-seed fuzz with
+    hypothesis-driven shapes."""
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        st.lists(
+            st.tuples(st.integers(0, 2), st.integers(0, 6),
+                      st.integers(0, 2)),
+            min_size=1, max_size=18,
+        )
+    )
+    def prop(ops):
+        cl = c.clist("s")
+        # fixed site ids so a failing example replays deterministically
+        sites = ["hypSiteA_____", "hypSiteB_____", "hypSiteC_____"]
+        for kind, target, site_i in ops:
+            site = sites[site_i]
+            nodes = sorted(cl.ct.nodes)
+            cause = nodes[target % len(nodes)]
+            ts = cl.get_ts() + 1
+            if kind == 0:
+                value = "v"
+            elif kind == 1:
+                value = c.hide
+            else:
+                value = c.h_show
+            cl = cl.insert(((ts, site, 0), cause, value))
+        na = NodeArrays.from_nodes_map(cl.ct.nodes)
+        hi, lo = na.id_lanes()
+        chi, clo = na.cause_lanes()
+        args = tuple(
+            jnp.asarray(x)
+            for x in (hi, lo, chi, clo, na.vclass, na.valid)
+        )
+        v1_v3_match(args, max(8, na.capacity))
+
+    prop()
+
+
 def test_v3_conflict_flag():
     """Two lanes sharing an id with different bodies raise the conflict
     flag through v3 exactly as v1."""

@@ -1,0 +1,153 @@
+"""CausalSet (OR-set) and CausalCounter tests: semantics, convergence
+across sites and backends, undo, serde round-trip, spec validity.
+These types are reference roadmap wishes (README.md:249-250) built on
+the list-tree machinery, so every backend accelerates them for free."""
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import spec
+from cause_tpu.collections.ccounter import CausalCounter
+from cause_tpu.collections.cset import CausalSet
+from cause_tpu.ids import new_site_id
+
+
+def fork(handle, cls):
+    return cls(handle.ct.evolve(site_id=new_site_id()))
+
+
+# ---------------------------- CausalSet ----------------------------
+
+
+def test_set_basics():
+    cs = c.cset("a", "b")
+    assert len(cs) == 2 and "a" in cs and "b" in cs and "z" not in cs
+    assert cs.causal_to_edn() == {"a", "b"}
+    # adding a present element mints a fresh tag node (OR-set law) but
+    # the rendered set is unchanged
+    again = cs.add("a")
+    assert again.causal_to_edn() == {"a", "b"}
+    assert len(again.get_nodes()) == len(cs.get_nodes()) + 1
+    cs2 = cs.discard("a")
+    assert cs2.causal_to_edn() == {"b"}
+    assert cs2.discard("zzz") is cs2        # absent -> no-op
+    assert set(cs2) == {"b"}
+    # re-add after remove is a fresh node and shows again
+    assert cs2.add("a").causal_to_edn() == {"a", "b"}
+    assert not spec.explain_tree(cs2.ct)
+
+
+def test_set_add_of_present_element_still_protects_against_remove():
+    """The hole the skip-if-present 'optimization' would open: B adds
+    an element it already sees while A concurrently removes it — B's
+    fresh tag is unobserved by A, so the element survives the merge."""
+    base = c.cset("x")
+    remover = fork(base, CausalSet).discard("x")
+    adder = fork(base, CausalSet).add("x")   # "x" already visible here
+    ab = remover.merge(adder)
+    ba = adder.merge(remover)
+    assert ab.causal_to_edn() == ba.causal_to_edn() == {"x"}
+
+
+def test_set_add_wins_over_concurrent_remove():
+    """The OR-set law: a remove only covers *observed* adds, so a
+    concurrent re-add survives the merge in both merge orders."""
+    base = c.cset("x")
+    remover = fork(base, CausalSet).discard("x")
+    readder = fork(base, CausalSet).discard("x").add("x")
+    ab = remover.merge(readder)
+    ba = readder.merge(remover)
+    assert ab.causal_to_edn() == ba.causal_to_edn() == {"x"}
+    assert ab.get_nodes() == ba.get_nodes()
+
+
+def test_set_observed_remove_covers_all_observed_adds():
+    base = c.cset()
+    a = fork(base, CausalSet).add("v")
+    b = fork(base, CausalSet).add("v")
+    both = a.merge(b)                       # two distinct add-nodes
+    removed = both.discard("v")             # observes and hides both
+    assert removed.causal_to_edn() == set()
+    # merging the original adders back changes nothing: all observed
+    assert removed.merge(a).merge(b).causal_to_edn() == set()
+
+
+@pytest.mark.parametrize("weaver", ["pure", "native", "jax"])
+def test_set_converges_across_backends(weaver):
+    base = c.cset("s", weaver=weaver)
+    a = fork(base, CausalSet).add("a1").discard("s")
+    b = fork(base, CausalSet).add("b1")
+    ab, ba = a.merge(b), b.merge(a)
+    assert ab.causal_to_edn() == ba.causal_to_edn() == {"a1", "b1"}
+    fleet = [fork(base, CausalSet).add(f"e{i}") for i in range(4)]
+    conv = fleet[0].merge_many(fleet[1:])
+    folded = fleet[0]
+    for r in fleet[1:]:
+        folded = folded.merge(r)
+    assert conv.causal_to_edn() == folded.causal_to_edn()
+
+
+def test_set_serde_round_trip():
+    cs = c.cset("a", "b").discard("a")
+    back = c.loads(c.dumps(cs))
+    assert isinstance(back, CausalSet)
+    assert back.causal_to_edn() == {"b"}
+    assert back.get_nodes() == cs.get_nodes()
+    # merging a round-tripped replica converges
+    other = fork(cs, CausalSet).add("c")
+    assert back.merge(other).causal_to_edn() == {"b", "c"}
+
+
+def test_set_type_guard():
+    with pytest.raises(c.CausalError):
+        c.cset("x").merge(c.clist("x"))
+
+
+# -------------------------- CausalCounter --------------------------
+
+
+def test_counter_basics():
+    cc = c.ccounter()
+    assert cc.value() == 0
+    cc = cc.increment(5).decrement(2).increment(0.5)
+    assert cc.value() == 3.5
+    assert int(cc.increment(0.5)) == 4
+    with pytest.raises(c.CausalError):
+        cc.increment("nope")
+    with pytest.raises(c.CausalError):
+        cc.increment(True)  # bools are not counter deltas
+    assert not spec.explain_tree(cc.ct)
+
+
+def test_counter_concurrent_increments_converge():
+    base = c.ccounter(10)
+    a = fork(base, CausalCounter).increment(7)
+    b = fork(base, CausalCounter).decrement(3)
+    ab, ba = a.merge(b), b.merge(a)
+    assert ab.value() == ba.value() == 14
+    assert ab.get_nodes() == ba.get_nodes()
+
+
+def test_counter_undo_delta():
+    cc = c.ccounter().increment(4).increment(6)
+    deltas = cc.deltas()
+    assert [d[2] for d in deltas] == [4, 6]
+    undone = cc.undo_delta(deltas[0][0])
+    assert undone.value() == 6
+    assert not spec.explain_tree(undone.ct)
+
+
+@pytest.mark.parametrize("weaver", ["pure", "native", "jax"])
+def test_counter_fleet_converges(weaver):
+    base = c.ccounter(weaver=weaver)
+    fleet = [fork(base, CausalCounter).increment(i + 1) for i in range(5)]
+    conv = fleet[0].merge_many(fleet[1:])
+    assert conv.value() == 1 + 2 + 3 + 4 + 5
+
+
+def test_counter_serde_round_trip():
+    cc = c.ccounter(3).increment(2)
+    back = c.loads(c.dumps(cc))
+    assert isinstance(back, CausalCounter)
+    assert back.value() == 5
+    assert back.merge(fork(cc, CausalCounter).increment(1)).value() == 6
