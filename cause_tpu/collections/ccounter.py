@@ -39,6 +39,14 @@ def counter_value(ct: CausalTree):
     )
 
 
+def _check_delta(n) -> None:
+    if not isinstance(n, Number) or isinstance(n, bool):
+        raise s.CausalError(
+            "Counter deltas must be numbers.",
+            {"causes": {"not-a-number"}, "value": n},
+        )
+
+
 class CausalCounter:
     """Immutable CausalCounter handle; mutating-looking methods return
     a new counter."""
@@ -113,14 +121,11 @@ class CausalCounter:
     # -- counter interop --
     def increment(self, n=1) -> "CausalCounter":
         """Record a delta (any number, so decrement = increment(-n))."""
-        if not isinstance(n, Number) or isinstance(n, bool):
-            raise s.CausalError(
-                "Counter deltas must be numbers.",
-                {"causes": {"not-a-number"}, "value": n},
-            )
+        _check_delta(n)
         return CausalCounter(c_list.conj_(self.ct, n))
 
     def decrement(self, n=1) -> "CausalCounter":
+        _check_delta(n)  # before negating: -True is int 1
         return self.increment(-n)
 
     def undo_delta(self, node_id) -> "CausalCounter":

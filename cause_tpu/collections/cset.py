@@ -34,10 +34,19 @@ def new_causal_tree(weaver: str = "pure") -> CausalTree:
 
 
 def visible_nodes_by_value(ct: CausalTree) -> dict:
-    """{element -> [visible nodes carrying it]} in weave order."""
+    """{element -> [visible nodes carrying it]} in weave order.
+    ``add`` fail-fasts on unhashable elements, but nodes can also
+    arrive through insert/merge/serde from a replica that did not —
+    surface those as CausalError here, not a bare TypeError."""
     out: dict = {}
     for node in c_list.causal_list_to_list(ct):
-        out.setdefault(node[2], []).append(node)
+        try:
+            out.setdefault(node[2], []).append(node)
+        except TypeError:
+            raise s.CausalError(
+                "set elements must be hashable",
+                {"id": node[0], "type": type(node[2]).__name__},
+            ) from None
     return out
 
 
@@ -124,6 +133,13 @@ class CausalSet:
         (a remove only covers the adds it observed). Skipping
         already-present values (the LWW map's assoc stance) would
         silently drop that protection."""
+        try:
+            hash(value)
+        except TypeError:
+            raise s.CausalError(
+                "set elements must be hashable",
+                {"type": type(value).__name__},
+            ) from None
         return CausalSet(c_list.conj_(self.ct, value))
 
     def discard(self, value) -> "CausalSet":

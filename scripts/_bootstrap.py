@@ -1,0 +1,11 @@
+"""Make ``cause_tpu`` importable when scripts run straight from a
+checkout (``python scripts/foo.py``) without ``pip install -e .`` —
+Python puts the script's directory on ``sys.path``, not the repo root.
+Import for its side effect: ``import _bootstrap  # noqa: F401``."""
+
+import os
+import sys
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)

@@ -8,6 +8,8 @@ Prints one JSON line per backend plus the breakdown.
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
 import argparse
 import json
 import time

@@ -16,6 +16,8 @@ Prints one JSON line per measurement.
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
 import argparse
 import json
 import time

@@ -1,0 +1,241 @@
+"""Pin down why the v3 kernel's flags/scans stage costs ~1.7 s at full
+size when a lone cumsum at the same shape costs ~24 ms: time each
+sub-expression of stages 0-3 in isolation at B=1024, N=20480.
+
+Methodology: each program is jitted, warmed, then timed 3x with the
+scalar-fetch sync; the dispatch floor (empty program) is printed first
+so marginal costs can be read by subtraction.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
+import math
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS
+from cause_tpu.weaver.arrays import I32_MAX
+from cause_tpu.weaver.jaxw3 import _shift1
+
+B, NB, ND, CAP = 1024, 9_000, 1_000, 10_240
+K = 2251
+
+
+def timed(name, fn, *args, reps=3):
+    out = np.asarray(fn(*args))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = np.asarray(fn(*args))
+        ts.append((time.perf_counter() - t0) * 1000.0)
+    print(f"{name:52s} {float(np.median(ts)):9.1f} ms")
+    return out
+
+
+def main():
+    print(f"platform={jax.devices()[0].platform} B={B} cap={CAP}")
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=NB, n_div=ND, capacity=CAP, hide_every=8
+    )
+    dev = [jax.device_put(batch[k]) for k in LANE_KEYS]
+    N = dev[0].shape[1]
+    hi, lo = dev[0], dev[1]
+
+    @jax.jit
+    def empty(h, l):
+        return jnp.float32(0) + h[0, 0] + l[0, 0]
+
+    timed("dispatch floor (scalar only)", empty, hi, lo)
+
+    @jax.jit
+    def sort_only(h, l):
+        order = jnp.vmap if False else None  # noqa
+        o = jax.vmap(lambda a, b: jnp.lexsort((b, a)))(h, l)
+        return jnp.sum(o.astype(jnp.float32))
+
+    timed("lexsort2 (indices only)", sort_only, hi, lo)
+
+    @jax.jit
+    def sort_apply6(*a):
+        def row(h, l, ch, cl, vc, va):
+            o = jnp.lexsort((l, h))
+            return (h[o], l[o], ch[o], cl[o], vc[o], va[o])
+
+        outs = jax.vmap(row)(*a)
+        return sum(jnp.sum(x.astype(jnp.float32)) for x in outs)
+
+    timed("lexsort2 + 6 perm gathers", sort_apply6, *dev)
+
+    @jax.jit
+    def sort_operands(*a):
+        def row(h, l, ch, cl, vc, va):
+            return lax.sort((h, l, ch, cl, vc, va.astype(jnp.int32)),
+                            num_keys=2)
+
+        outs = jax.vmap(row)(*a)
+        return sum(jnp.sum(x.astype(jnp.float32)) for x in outs)
+
+    timed("lax.sort 6 operands (num_keys=2)", sort_operands, *dev)
+
+    @jax.jit
+    def one_cumsum(h, l):
+        return jnp.sum(jnp.cumsum(h, axis=1).astype(jnp.float32))
+
+    timed("cumsum int32", one_cumsum, hi, lo)
+
+    @jax.jit
+    def one_cummax(h, l):
+        return jnp.sum(lax.cummax(h, axis=1).astype(jnp.float32))
+
+    timed("cummax int32", one_cummax, hi, lo)
+
+    @jax.jit
+    def two_scans(h, l):
+        return (jnp.sum(jnp.cumsum(h, axis=1).astype(jnp.float32))
+                + jnp.sum(lax.cummax(l, axis=1).astype(jnp.float32)))
+
+    timed("cumsum + cummax", two_scans, hi, lo)
+
+    # stage-1 flags WITHOUT the sort (feed raw lanes as if sorted)
+    @jax.jit
+    def flags_noscan(*a):
+        def row(h, l, ch, cl, vc, va):
+            idx = jnp.arange(N, dtype=jnp.int32)
+            prev_h, prev_l = _shift1(h, I32_MAX), _shift1(l, I32_MAX)
+            dup = (h == prev_h) & (l == prev_l) & (idx > 0)
+            keep = va & ~dup
+            is_root = keep & (idx == 0)
+            special = keep & (vc > 0)
+            rel = keep & ~is_root
+            adj = rel & (ch == prev_h) & (cl == prev_l)
+            return (keep.astype(jnp.float32).sum()
+                    + adj.astype(jnp.float32).sum()
+                    + special.astype(jnp.float32).sum())
+
+        return jnp.sum(jax.vmap(row)(*a))
+
+    timed("flags only (elementwise, no sort no scan)", flags_noscan, *dev)
+
+    @jax.jit
+    def flags_scans(*a):
+        def row(h, l, ch, cl, vc, va):
+            idx = jnp.arange(N, dtype=jnp.int32)
+            prev_h, prev_l = _shift1(h, I32_MAX), _shift1(l, I32_MAX)
+            dup = (h == prev_h) & (l == prev_l) & (idx > 0)
+            keep = va & ~dup
+            cum_keep = jnp.cumsum(keep.astype(jnp.int32))
+            kidx = cum_keep - 1
+            is_root = keep & (idx == 0)
+            special = keep & (vc > 0)
+            rel = keep & ~is_root
+            sp_pack = lax.cummax(
+                jnp.where(keep, idx * 2 + special.astype(jnp.int32), -1)
+            )
+            sp_prev = _shift1(sp_pack, -1)
+            prev_kept = jnp.where(sp_prev >= 0, sp_prev >> 1, -1)
+            prev_kept_special = (sp_prev >= 0) & (sp_prev % 2 == 1)
+            adj = (rel & (ch == prev_h) & (cl == prev_l)
+                   & (prev_kept >= 0))
+            host_case = adj & ~special & prev_kept_special
+            irregular = rel & (~adj | host_case)
+            return (kidx.astype(jnp.float32).sum()
+                    + irregular.astype(jnp.float32).sum())
+
+        return jnp.sum(jax.vmap(row)(*a))
+
+    timed("flags + scans (stage1 body, no sort)", flags_scans, *dev)
+
+    # searchsorted K targets into an N-wide nondecreasing array
+    cum = jnp.cumsum((dev[5]).astype(jnp.int32), axis=1)
+    targets = jnp.arange(1, K + 1, dtype=jnp.int32)
+
+    @jax.jit
+    def ss(c):
+        def row(cr):
+            return jnp.searchsorted(cr, targets, side="left").astype(
+                jnp.int32)
+
+        return jnp.sum(jax.vmap(row)(c).astype(jnp.float32))
+
+    timed("searchsorted K into N (jnp)", ss, cum)
+
+    # hand-rolled fori binary search (the kernel's sbody pattern)
+    @jax.jit
+    def bs(c):
+        def row(cr):
+            steps = max(1, math.ceil(math.log2(max(2, N)))) + 1
+
+            def sbody(_, carry):
+                lo_b, hi_b = carry
+                mid = (lo_b + hi_b) // 2
+                ms = jnp.clip(mid, 0, N - 1)
+                less = cr[ms] < targets
+                return (jnp.where(less, mid + 1, lo_b),
+                        jnp.where(less, hi_b, mid))
+
+            lo_b, _ = lax.fori_loop(
+                0, steps, sbody,
+                (jnp.zeros_like(targets), jnp.full_like(targets, N)),
+            )
+            return lo_b
+
+        return jnp.sum(jax.vmap(row)(c).astype(jnp.float32))
+
+    timed("fori binary search K into N", bs, cum)
+
+    # K-wide gather from an N-wide array
+    qidx = jnp.broadcast_to(
+        jnp.arange(K, dtype=jnp.int32) * 7 % N, (B, K)).copy()
+
+    @jax.jit
+    def kg(h, q):
+        def row(hr, qr):
+            return hr[qr]
+
+        return jnp.sum(jax.vmap(row)(h, q).astype(jnp.float32))
+
+    timed("ONE K-wide gather from N", kg, hi, qidx)
+
+    # pointer doubling at 2K width, 13 rounds (euler_rank core)
+    nxt = jnp.broadcast_to(
+        (jnp.arange(2 * K, dtype=jnp.int32) * 5 + 1) % (2 * K),
+        (B, 2 * K)).copy()
+    w = jnp.ones((B, 2 * K), jnp.int32)
+
+    @jax.jit
+    def pd(nx, ww):
+        def row(n, v):
+            def body(_, c):
+                val, x = c
+                return val + val[x], x[x]
+
+            val, _ = lax.fori_loop(0, 13, body, (v, n))
+            return val
+
+        return jnp.sum(jax.vmap(row)(nx, ww).astype(jnp.float32))
+
+    timed("pointer doubling 13 rounds at 2K", pd, nxt, w)
+
+    # K->N scatter (.at[].set)
+    vals = jnp.ones((B, K), jnp.int32)
+
+    @jax.jit
+    def sc(q, v):
+        def row(qr, vr):
+            return jnp.zeros(N, jnp.int32).at[qr].set(vr, mode="drop")
+
+        return jnp.sum(jax.vmap(row)(q, v).astype(jnp.float32))
+
+    timed("ONE K->N scatter", sc, qidx, vals)
+
+
+if __name__ == "__main__":
+    main()

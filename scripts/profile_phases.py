@@ -10,6 +10,8 @@ block on the axon tunnel).
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
 import argparse
 import math
 import time

@@ -12,6 +12,8 @@ longer sum to it."""
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
 import argparse
 import math
 import time

@@ -11,6 +11,8 @@ Defaults: 64 9000 1000 3  (one-sixteenth of the north-star batch).
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
 import json
 import sys
 import time

@@ -17,6 +17,8 @@ Names: elementwise cumsum gather rowgather lexsort2 lexsort3 scatter
 
 from __future__ import annotations
 
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
 import sys
 import time
 

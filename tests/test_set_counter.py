@@ -34,6 +34,9 @@ def test_set_basics():
     assert set(cs2) == {"b"}
     # re-add after remove is a fresh node and shows again
     assert cs2.add("a").causal_to_edn() == {"a", "b"}
+    # unhashable values fail fast at add (not at the next read)
+    with pytest.raises(c.CausalError):
+        cs.add([1, 2])
     assert not spec.explain_tree(cs2.ct)
 
 
@@ -116,6 +119,10 @@ def test_counter_basics():
         cc.increment("nope")
     with pytest.raises(c.CausalError):
         cc.increment(True)  # bools are not counter deltas
+    with pytest.raises(c.CausalError):
+        cc.decrement(True)  # -True is int 1; the guard must fire first
+    with pytest.raises(c.CausalError):
+        cc.decrement("nope")
     assert not spec.explain_tree(cc.ct)
 
 
