@@ -72,7 +72,7 @@ def measure(platform: str) -> dict:
         jax.config.update("jax_platforms", "cpu")
 
     from cause_tpu import benchgen
-    from cause_tpu.benchgen import LANE_KEYS, merge_wave_scalar
+    from cause_tpu.benchgen import LANE_KEYS, LANE_KEYS4, merge_wave_scalar
 
     real_platform = jax.devices()[0].platform
     smoke = (
@@ -89,21 +89,27 @@ def measure(platform: str) -> dict:
     batch = benchgen.batched_pair_lanes(
         n_replicas=B, n_base=n_base, n_div=n_div, capacity=cap, hide_every=8
     )
-    args = [jax.device_put(batch[k]) for k in LANE_KEYS]
+    dev = {
+        k: jax.device_put(batch[k])
+        for k in dict.fromkeys(LANE_KEYS + LANE_KEYS4)
+    }
 
     budget = benchgen.pair_run_budget(batch)
 
     def step(k: int, kernel: str) -> None:
+        lanes = LANE_KEYS4 if kernel == "v4" else LANE_KEYS
+        args = [dev[name] for name in lanes]
         # one transfer fetches checksum + overflow and forces execution
         out = np.asarray(merge_wave_scalar(*args, k_max=k, kernel=kernel))
         if k and out[1]:  # overflowed rows carry garbage ranks
             raise _Overflow()
 
-    # compile + warmup; the fastest kernel (v3 sparse-irregular) first,
-    # then the chain-compressed v2 with a doubled budget, then the
-    # uncompressed v1 (k_max=0, cannot overflow) before giving up.
-    # An unsampled row blowing the sampled run budget is recoverable.
-    for k_max, kernel in ((budget, "v3"), (2 * budget, "v3"),
+    # compile + warmup; the fastest kernel (v4 marshal-resolved) first.
+    # No v3 rung: v3/v4 share the run decomposition, so a budget that
+    # overflows v4 is guaranteed to overflow v3 too — fall straight to
+    # the chain-compressed v2 with a doubled budget, then the
+    # uncompressed v1 (k_max=0, cannot overflow).
+    for k_max, kernel in ((budget, "v4"), (2 * budget, "v4"),
                           (2 * budget, "v2"), (0, "v1")):
         try:
             step(k_max, kernel)

@@ -43,9 +43,13 @@ __all__ = [
     "pair_run_budget",
     "merge_wave_scalar",
     "LANE_KEYS",
+    "LANE_KEYS4",
 ]
 
 LANE_KEYS = ("hi", "lo", "chi", "clo", "vc", "valid")
+# the v4 kernel's lanes: cause ids are replaced by ``cci``, the cause's
+# index in the concatenated pre-sort lane array (known at marshal time)
+LANE_KEYS4 = ("hi", "lo", "cci", "vc", "valid")
 
 def _union_lanes_np(hi, lo, chi, clo, vc, valid):
     """Numpy twin of the merge kernel's front half (id lexsort, dup
@@ -116,10 +120,12 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
     device->host transfer is the only reliable sync point.
 
     ``k_max`` > 0 selects a compressed kernel — ``kernel`` picks which
-    ("v2" chain-compressed, "v3" sparse-irregular) — with that run
-    budget, returning a length-2 device array ``[checksum,
-    n_overflowed_rows]`` (one transfer fetches both); ``k_max=0`` runs
-    the uncompressed v1 kernel and returns just the checksum.
+    ("v2" chain-compressed, "v3" sparse-irregular, "v4"
+    marshal-resolved causes) — with that run budget, returning a
+    length-2 device array ``[checksum, n_overflowed_rows]`` (one
+    transfer fetches both); ``k_max=0`` runs the uncompressed v1 kernel
+    and returns just the checksum. v1-v3 take the ``LANE_KEYS`` lanes,
+    v4 the ``LANE_KEYS4`` lanes.
     """
     key = (k_max, kernel if k_max > 0 else "v1")
     program = _scalar_programs.get(key)
@@ -138,7 +144,11 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
             )
 
         if k_max > 0:
-            if kernel == "v3":
+            if kernel == "v4":
+                from .weaver.jaxw4 import batched_merge_weave_v4
+
+                batched = batched_merge_weave_v4
+            elif kernel == "v3":
                 from .weaver.jaxw3 import batched_merge_weave_v3
 
                 batched = batched_merge_weave_v3
@@ -215,6 +225,7 @@ def chain_tree_lanes(
     lo = np.full(capacity, I32_MAX, np.int32)
     chi = np.full(capacity, -1, np.int32)
     clo = np.full(capacity, -1, np.int32)
+    cci = np.full(capacity, -1, np.int32)
     vcl = np.zeros(capacity, np.int32)
     valid = np.zeros(capacity, bool)
 
@@ -222,9 +233,11 @@ def chain_tree_lanes(
     lo[:n] = (site.astype(np.int32) << spec.tx_bits) | tx.astype(np.int32)[:n]
     chi[1:n] = cts[1:].astype(np.int32)
     clo[1:n] = (csite[1:].astype(np.int32) << spec.tx_bits)
+    cci[1:n] = np.arange(n - 1, dtype=np.int32)  # chain: cause = lane i-1
     vcl[:n] = vc
     valid[:n] = True
-    return {"hi": hi, "lo": lo, "chi": chi, "clo": clo, "vc": vcl, "valid": valid}
+    return {"hi": hi, "lo": lo, "chi": chi, "clo": clo, "cci": cci,
+            "vc": vcl, "valid": valid}
 
 
 def divergent_pair_lanes(
@@ -238,7 +251,12 @@ def divergent_pair_lanes(
     the per-replica input of ``merge_weave_kernel``."""
     a = chain_tree_lanes(n_base, n_div, SITE_A, capacity, hide_every, spec)
     b = chain_tree_lanes(n_base, n_div, SITE_B, capacity, hide_every, spec)
-    return {k: np.concatenate([a[k], b[k]]) for k in a}
+    out = {k: np.concatenate([a[k], b[k]]) for k in a}
+    # cci is a concat index: the second tree's causes shift by capacity
+    out["cci"][capacity:] = np.where(
+        b["cci"] >= 0, b["cci"] + capacity, -1
+    )
+    return out
 
 
 def fleet_lanes(
@@ -268,6 +286,9 @@ def fleet_lanes(
             j = np.arange(1, n_div + 1)
             is_hide = ((j + r) % hide_every) == 0
             row["vc"][1 + n_base:1 + n_base + n_div][is_hide] = VCLASS_HIDE
+        row["cci"] = np.where(
+            row["cci"] >= 0, row["cci"] + r * capacity, -1
+        ).astype(np.int32)
         rows.append(row)
     return {k: np.concatenate([row[k] for row in rows]) for k in rows[0]}
 

@@ -194,25 +194,27 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
                           n_base: int = 800, n_div: int = 100,
                           cap: int = 1024, reps: int = 3,
                           k_max: Optional[int] = None,
-                          kernel: str = "v3",
+                          kernel: str = "v4",
                           profile_dir: Optional[str] = None) -> dict:
     """Batched device merge of divergent replicas (north-star shape;
     sizes here are CLI defaults — bench.py runs the full 1024x10k).
     ``k_max``: None = workload-derived run budget, 0 = the uncompressed
-    v1 kernel. ``kernel`` picks the compressed kernel ("v3"
-    sparse-irregular, the same default bench.py measures, or "v2"
-    chain-compressed)."""
+    v1 kernel. ``kernel`` picks the compressed kernel ("v4"
+    marshal-resolved causes, the same default bench.py measures, "v3"
+    sparse-irregular, or "v2" chain-compressed); v4 consumes the
+    LANE_KEYS4 lanes, the others LANE_KEYS."""
     import numpy as _np
 
     import jax
 
-    from .benchgen import LANE_KEYS, merge_wave_scalar
+    from .benchgen import LANE_KEYS, LANE_KEYS4, merge_wave_scalar
 
     batch = benchgen.batched_pair_lanes(
         n_replicas=n_replicas, n_base=n_base, n_div=n_div,
         capacity=cap, hide_every=8,
     )
-    args = [jax.device_put(batch[k]) for k in LANE_KEYS]
+    lane_names = LANE_KEYS4 if (kernel == "v4" and k_max != 0) else LANE_KEYS
+    args = [jax.device_put(batch[k]) for k in lane_names]
     if k_max is None:
         k_max = benchgen.pair_run_budget(batch)
 

@@ -6,4 +6,5 @@ from .mesh import (  # noqa: F401
     make_mesh,
     replica_digest,
     sharded_merge_weave,
+    sharded_merge_weave_v4,
 )

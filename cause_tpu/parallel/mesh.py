@@ -41,6 +41,7 @@ __all__ = [
     "make_mesh",
     "replica_digest",
     "sharded_merge_weave",
+    "sharded_merge_weave_v4",
 ]
 
 REPLICA_AXIS = "replicas"
@@ -74,6 +75,19 @@ def replica_digest(hi_sorted, lo_sorted, rank, visible):
     return jnp.sum(jnp.where(kept, mix, jnp.uint32(0)))
 
 
+def _fleet_stats(axis, hi, lo, order, rank, visible, conflict, overflow):
+    """The shared sharded-step epilogue: per-replica digests plus the
+    psum-reduced fleet stats every kernel variant reports."""
+    n_overflow = lax.psum(jnp.sum(overflow.astype(jnp.int32)), axis)
+    hi_sorted = jnp.take_along_axis(hi, order, axis=1)
+    lo_sorted = jnp.take_along_axis(lo, order, axis=1)
+    digest = jax.vmap(replica_digest)(hi_sorted, lo_sorted, rank, visible)
+    total_visible = lax.psum(jnp.sum(visible.astype(jnp.int32)), axis)
+    n_conflicts = lax.psum(jnp.sum(conflict.astype(jnp.int32)), axis)
+    return (order, rank, visible, digest, total_visible, n_conflicts,
+            n_overflow)
+
+
 @lru_cache(maxsize=8)
 def _sharded_step(mesh: Mesh, k_max: int, kernel: str = "v3"):
     """The jitted sharded merge step for one mesh (cached so repeat
@@ -102,19 +116,13 @@ def _sharded_step(mesh: Mesh, k_max: int, kernel: str = "v3"):
             order, rank, visible, conflict, overflow = jax.vmap(
                 lambda *r: _compressed(*r, k_max)
             )(hi, lo, chi, clo, vc, va)
-            n_overflow = lax.psum(jnp.sum(overflow.astype(jnp.int32)), axis)
         else:
             order, rank, visible, conflict = jax.vmap(merge_weave_kernel)(
                 hi, lo, chi, clo, vc, va
             )
-            n_overflow = lax.psum(jnp.zeros((), jnp.int32), axis)
-        hi_sorted = jnp.take_along_axis(hi, order, axis=1)
-        lo_sorted = jnp.take_along_axis(lo, order, axis=1)
-        digest = jax.vmap(replica_digest)(hi_sorted, lo_sorted, rank, visible)
-        total_visible = lax.psum(jnp.sum(visible.astype(jnp.int32)), axis)
-        n_conflicts = lax.psum(jnp.sum(conflict.astype(jnp.int32)), axis)
-        return (order, rank, visible, digest, total_visible, n_conflicts,
-                n_overflow)
+            overflow = jnp.zeros(conflict.shape, bool)
+        return _fleet_stats(axis, hi, lo, order, rank, visible, conflict,
+                            overflow)
 
     return jax.jit(step)
 
@@ -136,3 +144,39 @@ def sharded_merge_weave(mesh: Mesh, hi, lo, cause_hi, cause_lo, vclass, valid,
     # so k_max=0 calls must not mint per-kernel duplicate programs
     step = _sharded_step(mesh, k_max, kernel if k_max > 0 else "v1")
     return step(hi, lo, cause_hi, cause_lo, vclass, valid)
+
+
+@lru_cache(maxsize=8)
+def _sharded_step_v4(mesh: Mesh, k_max: int):
+    """The v4 twin of ``_sharded_step``: 5 lanes (cause ids replaced by
+    the marshal-time concat cause-index lane), same outputs."""
+    from ..weaver.jaxw4 import merge_weave_kernel_v4
+
+    axis = mesh.axis_names[0]
+    sharded = P(axis)
+    replicated = P()
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=(sharded,) * 5,
+        out_specs=(sharded, sharded, sharded, sharded, replicated,
+                   replicated, replicated),
+    )
+    def step(hi, lo, cci, vc, va):
+        order, rank, visible, conflict, overflow = jax.vmap(
+            lambda *r: merge_weave_kernel_v4(*r, k_max)
+        )(hi, lo, cci, vc, va)
+        return _fleet_stats(axis, hi, lo, order, rank, visible, conflict,
+                            overflow)
+
+    return jax.jit(step)
+
+
+def sharded_merge_weave_v4(mesh: Mesh, hi, lo, cci, vclass, valid,
+                           k_max: int):
+    """``sharded_merge_weave`` for the v4 kernel: lanes carry ``cci``
+    (the cause's index in the concatenated pre-sort array, resolved at
+    marshal time) instead of cause id lanes. Same outputs; the batch
+    dimension must be divisible by the mesh size."""
+    return _sharded_step_v4(mesh, k_max)(hi, lo, cci, vclass, valid)

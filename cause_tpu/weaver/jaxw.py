@@ -467,26 +467,24 @@ def _run_budget(capacity: int) -> int:
 
 def weave_arrays(na: NodeArrays) -> Tuple[np.ndarray, np.ndarray]:
     """Run the device linearization for one tree; returns host-side
-    ``(rank, visible)`` numpy arrays. Prefers the sparse-irregular v3
-    merge kernel (single-tree inputs are just an already-sorted,
-    duplicate-free merge), falls back to the chain-compressed v2 and
-    then the uncompressed v1 when the run budget overflows (the
-    estimate is computed host-side, so a branchy tree never pays for a
-    doomed compressed dispatch)."""
-    from .jaxw3 import merge_weave_kernel_v3_jit
+    ``(rank, visible)`` numpy arrays. Prefers the v4 merge kernel
+    (single-tree inputs are just an already-sorted, duplicate-free
+    merge whose causes are marshal-resolved in ``cause_idx``), falls
+    back to the chain-compressed v2 and then the uncompressed v1 when
+    the run budget overflows (the estimate is computed host-side, so a
+    branchy tree never pays for a doomed compressed dispatch)."""
+    from .jaxw4 import merge_weave_kernel_v4_jit
 
     hi, lo = na.id_lanes()
     k_max = _run_budget(na.capacity)
     fits = estimate_runs(na.cause_idx, na.vclass, na.valid) <= k_max
     if fits:
-        chi, clo = na.cause_lanes()
-        _, rank, visible, _, overflow = merge_weave_kernel_v3_jit(
-            jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(chi),
-            jnp.asarray(clo), jnp.asarray(na.vclass),
-            jnp.asarray(na.valid), k_max=k_max,
+        _, rank, visible, _, overflow = merge_weave_kernel_v4_jit(
+            jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(na.cause_idx),
+            jnp.asarray(na.vclass), jnp.asarray(na.valid), k_max=k_max,
         )
         if not bool(overflow):
-            # v3 ranks are per *sorted* lane, but single-tree lanes are
+            # v4 ranks are per *sorted* lane, but single-tree lanes are
             # already id-sorted, so the identity order carries over
             return np.asarray(rank), np.asarray(visible)
     args = (

@@ -118,7 +118,7 @@ def build_batch(rng, B, cap, n_edits=5, seed_word="ab"):
         pairs.append((a.ct, bb.ct))
         sites |= {i[1] for i in a.ct.nodes} | {i[1] for i in bb.ct.nodes}
     interner = SiteInterner(sites)
-    lanes = {k: [] for k in ("hi", "lo", "chi", "clo", "vc", "valid")}
+    lanes = {k: [] for k in ("hi", "lo", "chi", "clo", "cci", "vc", "valid")}
     metas = []
     for a_ct, b_ct in pairs:
         na, (ahi, alo), (achi, aclo) = _tree_lanes(a_ct, interner, cap)
@@ -127,6 +127,12 @@ def build_batch(rng, B, cap, n_edits=5, seed_word="ab"):
         lanes["lo"].append(np.concatenate([alo, blo]))
         lanes["chi"].append(np.concatenate([achi, bchi]))
         lanes["clo"].append(np.concatenate([aclo, bclo]))
+        lanes["cci"].append(np.concatenate([
+            na.cause_idx,
+            np.where(nb.cause_idx >= 0, nb.cause_idx + cap, -1).astype(
+                np.int32
+            ),
+        ]))
         lanes["vc"].append(np.concatenate([na.vclass, nb.vclass]))
         lanes["valid"].append(np.concatenate([na.valid, nb.valid]))
         metas.append((na, nb))

@@ -12,7 +12,11 @@ import cause_tpu as c
 from cause_tpu.collections import clist as c_list
 from cause_tpu.collections import shared as s
 from cause_tpu.ids import new_site_id
-from cause_tpu.parallel import make_mesh, sharded_merge_weave
+from cause_tpu.parallel import (
+    make_mesh,
+    sharded_merge_weave,
+    sharded_merge_weave_v4,
+)
 from cause_tpu.weaver.arrays import NodeArrays, SiteInterner
 
 from test_jax_weaver import (
@@ -59,6 +63,16 @@ def test_sharded_merge_matches_pure():
     assert np.array_equal(np.asarray(v2), visible)
     assert np.array_equal(np.asarray(d2), np.asarray(digest))
     assert int(tv2) == int(total_visible)
+    # the v4 (marshal-resolved causes) sharded kernel agrees end to end
+    o4, r4, v4, d4, tv4, nc4, no4 = sharded_merge_weave_v4(
+        mesh, lanes["hi"], lanes["lo"], lanes["cci"],
+        lanes["vc"], lanes["valid"], k_max=2 * cap,
+    )
+    assert int(no4) == 0 and int(nc4) == 0
+    assert np.array_equal(np.asarray(r4), rank)
+    assert np.array_equal(np.asarray(v4), visible)
+    assert np.array_equal(np.asarray(d4), np.asarray(digest))
+    assert int(tv4) == int(total_visible)
     expect_total = 0
     for bidx, (a_ct, b_ct) in enumerate(pairs):
         pure = s.merge_trees(c_list.weave, a_ct, b_ct)
