@@ -121,15 +121,18 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
 
     ``k_max`` > 0 selects a compressed kernel — ``kernel`` picks which
     ("v2" chain-compressed, "v3" sparse-irregular, "v4"
-    marshal-resolved causes) — with that run budget, returning a
-    length-2 device array ``[checksum, n_overflowed_rows]`` (one
-    transfer fetches both); ``k_max=0`` runs the uncompressed v1 kernel
-    and returns just the checksum. v1-v3 take the ``LANE_KEYS`` lanes,
-    v4 the ``LANE_KEYS4`` lanes.
+    marshal-resolved causes, "v4w" = v4 with the sequential Pallas
+    euler walk) — with that run budget, returning a length-2 device
+    array ``[checksum, n_overflowed_rows]`` (one transfer fetches
+    both); ``k_max=0`` runs the uncompressed v1 kernel and returns
+    just the checksum. v1-v3 take the ``LANE_KEYS`` lanes, v4/v4w the
+    ``LANE_KEYS4`` lanes.
     """
     key = (k_max, kernel if k_max > 0 else "v1")
     program = _scalar_programs.get(key)
     if program is None:
+        import functools
+
         import jax
         import jax.numpy as jnp
 
@@ -144,10 +147,13 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
             )
 
         if k_max > 0:
-            if kernel == "v4":
+            if kernel in ("v4", "v4w"):
                 from .weaver.jaxw4 import batched_merge_weave_v4
 
-                batched = batched_merge_weave_v4
+                batched = functools.partial(
+                    batched_merge_weave_v4,
+                    euler="walk" if kernel == "v4w" else "doubling",
+                )
             elif kernel == "v3":
                 from .weaver.jaxw3 import batched_merge_weave_v3
 

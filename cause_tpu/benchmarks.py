@@ -213,7 +213,9 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
         n_replicas=n_replicas, n_base=n_base, n_div=n_div,
         capacity=cap, hide_every=8,
     )
-    lane_names = LANE_KEYS4 if (kernel == "v4" and k_max != 0) else LANE_KEYS
+    lane_names = (
+        LANE_KEYS4 if (kernel in ("v4", "v4w") and k_max != 0) else LANE_KEYS
+    )
     args = [jax.device_put(batch[k]) for k in lane_names]
     if k_max is None:
         k_max = benchgen.pair_run_budget(batch)

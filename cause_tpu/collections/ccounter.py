@@ -17,6 +17,7 @@ from typing import Optional
 from ..ids import HIDE
 from . import clist as c_list
 from . import shared as s
+from .handle import ListTreeHandle
 from .shared import CausalTree
 
 __all__ = [
@@ -47,72 +48,14 @@ def _check_delta(n) -> None:
         )
 
 
-class CausalCounter:
+class CausalCounter(ListTreeHandle):
     """Immutable CausalCounter handle; mutating-looking methods return
-    a new counter."""
+    a new counter. The shared protocol surface (metadata,
+    insert/append/weft, merge dispatch) lives on ``ListTreeHandle``."""
 
     __slots__ = ("ct",)
 
-    def __init__(self, ct: CausalTree):
-        object.__setattr__(self, "ct", ct)
-
-    def __setattr__(self, *a):
-        raise AttributeError("CausalCounter is immutable")
-
-    # -- CausalMeta --
-    def get_uuid(self) -> str:
-        return self.ct.uuid
-
-    def get_ts(self) -> int:
-        return self.ct.lamport_ts
-
-    def get_site_id(self) -> str:
-        return self.ct.site_id
-
-    # -- CausalTree protocol --
-    def get_weave(self):
-        return self.ct.weave
-
-    def get_nodes(self):
-        return self.ct.nodes
-
-    def insert(self, node, more_nodes=None) -> "CausalCounter":
-        return CausalCounter(
-            s.insert(c_list.weave, self.ct, node, more_nodes)
-        )
-
-    def append(self, cause, value) -> "CausalCounter":
-        return CausalCounter(s.append(c_list.weave, self.ct, cause, value))
-
-    def weft(self, ids_to_cut_yarns) -> "CausalCounter":
-        return CausalCounter(
-            s.weft(c_list.weave,
-                   lambda: new_causal_tree(self.ct.weaver),
-                   self.ct, ids_to_cut_yarns)
-        )
-
-    def merge(self, other: "CausalCounter") -> "CausalCounter":
-        if self.ct.weaver == "jax":
-            from ..weaver import jaxw
-
-            return CausalCounter(jaxw.merge_list_trees(self.ct, other.ct))
-        if self.ct.weaver == "native":
-            from ..weaver import nativew
-
-            return CausalCounter(nativew.merge_trees(self.ct, other.ct))
-        return CausalCounter(s.merge_trees(c_list.weave, self.ct, other.ct))
-
-    def merge_many(self, others) -> "CausalCounter":
-        if self.ct.weaver == "jax":
-            from ..weaver import jaxw
-
-            return CausalCounter(
-                jaxw.merge_many_list_trees(
-                    [self.ct] + [o.ct for o in others]
-                )
-            )
-        ct = s.union_nodes_many([self.ct] + [o.ct for o in others])
-        return CausalCounter(c_list.weave(ct))
+    _fresh = staticmethod(new_causal_tree)
 
     # -- CausalTo --
     def causal_to_edn(self, opts: Optional[dict] = None):
@@ -145,25 +88,11 @@ class CausalCounter:
     def __int__(self) -> int:
         return int(counter_value(self.ct))
 
-    def __eq__(self, other) -> bool:
-        return isinstance(other, CausalCounter) and self.ct == other.ct
-
-    def __hash__(self) -> int:
-        return hash((self.ct.uuid, self.ct.lamport_ts, self.ct.site_id,
-                     tuple(sorted(self.ct.nodes))))
-
     def __repr__(self) -> str:
         return f"#causal/counter {counter_value(self.ct)!r}"
 
     def __str__(self) -> str:
         return str(counter_value(self.ct))
-
-    # -- IObj/IMeta analogue --
-    def with_meta(self, m) -> "CausalCounter":
-        return CausalCounter(self.ct.evolve(meta=m))
-
-    def meta(self):
-        return self.ct.meta
 
 
 def new_causal_counter(start=0, weaver: str = "pure") -> CausalCounter:

@@ -24,6 +24,7 @@ from ..ids import (
 )
 from ..weaver import pure
 from . import shared as s
+from .handle import ListTreeHandle
 from .shared import CausalTree
 
 __all__ = [
@@ -160,79 +161,18 @@ def causal_list_to_list(ct: CausalTree) -> list:
     return out
 
 
-class CausalList:
+class CausalList(ListTreeHandle):
     """Immutable CausalList handle (list.cljc:74-178).
 
     ``len`` counts active values; iteration yields visible nodes.
-    All mutating-looking methods return a new CausalList.
+    All mutating-looking methods return a new CausalList. The shared
+    protocol surface (metadata, insert/append/weft, pure/native/jax
+    merge dispatch) lives on ``ListTreeHandle``.
     """
 
     __slots__ = ("ct",)
 
-    def __init__(self, ct: CausalTree):
-        object.__setattr__(self, "ct", ct)
-
-    def __setattr__(self, *a):
-        raise AttributeError("CausalList is immutable")
-
-    # -- CausalMeta (protocols.cljc:3-10) --
-    def get_uuid(self) -> str:
-        return self.ct.uuid
-
-    def get_ts(self) -> int:
-        return self.ct.lamport_ts
-
-    def get_site_id(self) -> str:
-        return self.ct.site_id
-
-    # -- CausalTree protocol (protocols.cljc:12-31) --
-    def get_weave(self):
-        return self.ct.weave
-
-    def get_nodes(self):
-        return self.ct.nodes
-
-    def insert(self, node, more_nodes=None) -> "CausalList":
-        return CausalList(s.insert(weave, self.ct, node, more_nodes))
-
-    def append(self, cause, value) -> "CausalList":
-        return CausalList(s.append(weave, self.ct, cause, value))
-
-    def weft(self, ids_to_cut_yarns) -> "CausalList":
-        return CausalList(
-            s.weft(weave, lambda: new_causal_tree(self.ct.weaver), self.ct,
-                   ids_to_cut_yarns)
-        )
-
-    def merge(self, other: "CausalList") -> "CausalList":
-        if self.ct.weaver == "jax":
-            from ..weaver import jaxw
-
-            return CausalList(jaxw.merge_list_trees(self.ct, other.ct))
-        if self.ct.weaver == "native":
-            from ..weaver import nativew
-
-            return CausalList(nativew.merge_trees(self.ct, other.ct))
-        return CausalList(s.merge_trees(weave, self.ct, other.ct))
-
-    def merge_many(self, others) -> "CausalList":
-        """Converge a whole fleet in one pass: N-way node union + one
-        full reweave (the weave is a pure function of the node set, so
-        this equals any fold of pairwise merges). No reference
-        analogue — the reference folds pairwise (shared.cljc:300-314).
-        Under ``weaver="jax"`` the union, validations and reweave are
-        all set-algebra/vectorized/device work — no per-node Python
-        loop."""
-        if self.ct.weaver == "jax":
-            from ..weaver import jaxw
-
-            return CausalList(
-                jaxw.merge_many_list_trees([self.ct] + [o.ct for o in others])
-            )
-        ct = s.union_nodes_many(
-            [self.ct] + [o.ct for o in others]
-        )
-        return CausalList(weave(ct))
+    _fresh = staticmethod(new_causal_tree)
 
     # -- CausalTo (protocols.cljc:33-35) --
     def causal_to_edn(self, opts: Optional[dict] = None) -> list:
@@ -288,20 +228,6 @@ class CausalList:
         if isinstance(i, int) and -len(vals) <= i < len(vals):
             return vals[i]
         return not_found
-
-    # -- IObj/IMeta analogue (list.cljc:97-101) --
-    def with_meta(self, m) -> "CausalList":
-        return CausalList(self.ct.evolve(meta=m))
-
-    def meta(self):
-        return self.ct.meta
-
-    def __eq__(self, other) -> bool:
-        return isinstance(other, CausalList) and self.ct == other.ct
-
-    def __hash__(self) -> int:
-        return hash((self.ct.uuid, self.ct.lamport_ts, self.ct.site_id,
-                     tuple(sorted(self.ct.nodes))))
 
     def __repr__(self) -> str:
         return f"#causal/list {causal_list_to_edn(self.ct)!r}"

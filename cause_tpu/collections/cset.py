@@ -21,6 +21,7 @@ from typing import Optional
 from ..ids import HIDE
 from . import clist as c_list
 from . import shared as s
+from .handle import ListTreeHandle
 from .shared import CausalTree
 
 __all__ = ["SET_TYPE", "CausalSet", "new_causal_set", "new_causal_tree"]
@@ -56,70 +57,15 @@ def causal_set_to_edn(ct: CausalTree, opts: Optional[dict] = None) -> set:
     }
 
 
-class CausalSet:
+class CausalSet(ListTreeHandle):
     """Immutable CausalSet handle. ``len``/iteration cover the distinct
-    visible elements; all mutating-looking methods return a new set."""
+    visible elements; all mutating-looking methods return a new set.
+    The shared protocol surface (metadata, insert/append/weft, merge
+    dispatch) lives on ``ListTreeHandle``."""
 
     __slots__ = ("ct",)
 
-    def __init__(self, ct: CausalTree):
-        object.__setattr__(self, "ct", ct)
-
-    def __setattr__(self, *a):
-        raise AttributeError("CausalSet is immutable")
-
-    # -- CausalMeta --
-    def get_uuid(self) -> str:
-        return self.ct.uuid
-
-    def get_ts(self) -> int:
-        return self.ct.lamport_ts
-
-    def get_site_id(self) -> str:
-        return self.ct.site_id
-
-    # -- CausalTree protocol --
-    def get_weave(self):
-        return self.ct.weave
-
-    def get_nodes(self):
-        return self.ct.nodes
-
-    def insert(self, node, more_nodes=None) -> "CausalSet":
-        return CausalSet(s.insert(c_list.weave, self.ct, node, more_nodes))
-
-    def append(self, cause, value) -> "CausalSet":
-        return CausalSet(s.append(c_list.weave, self.ct, cause, value))
-
-    def weft(self, ids_to_cut_yarns) -> "CausalSet":
-        return CausalSet(
-            s.weft(c_list.weave,
-                   lambda: new_causal_tree(self.ct.weaver),
-                   self.ct, ids_to_cut_yarns)
-        )
-
-    def merge(self, other: "CausalSet") -> "CausalSet":
-        if self.ct.weaver == "jax":
-            from ..weaver import jaxw
-
-            return CausalSet(jaxw.merge_list_trees(self.ct, other.ct))
-        if self.ct.weaver == "native":
-            from ..weaver import nativew
-
-            return CausalSet(nativew.merge_trees(self.ct, other.ct))
-        return CausalSet(s.merge_trees(c_list.weave, self.ct, other.ct))
-
-    def merge_many(self, others) -> "CausalSet":
-        if self.ct.weaver == "jax":
-            from ..weaver import jaxw
-
-            return CausalSet(
-                jaxw.merge_many_list_trees(
-                    [self.ct] + [o.ct for o in others]
-                )
-            )
-        ct = s.union_nodes_many([self.ct] + [o.ct for o in others])
-        return CausalSet(c_list.weave(ct))
+    _fresh = staticmethod(new_causal_tree)
 
     # -- CausalTo --
     def causal_to_edn(self, opts: Optional[dict] = None) -> set:
@@ -168,25 +114,11 @@ class CausalSet:
     def __iter__(self):
         return iter(visible_nodes_by_value(self.ct))
 
-    def __eq__(self, other) -> bool:
-        return isinstance(other, CausalSet) and self.ct == other.ct
-
-    def __hash__(self) -> int:
-        return hash((self.ct.uuid, self.ct.lamport_ts, self.ct.site_id,
-                     tuple(sorted(self.ct.nodes))))
-
     def __repr__(self) -> str:
         return f"#causal/set {causal_set_to_edn(self.ct)!r}"
 
     def __str__(self) -> str:
         return str(causal_set_to_edn(self.ct))
-
-    # -- IObj/IMeta analogue --
-    def with_meta(self, m) -> "CausalSet":
-        return CausalSet(self.ct.evolve(meta=m))
-
-    def meta(self):
-        return self.ct.meta
 
 
 def new_causal_set(*items, weaver: str = "pure") -> CausalSet:

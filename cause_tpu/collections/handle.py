@@ -1,0 +1,124 @@
+"""Shared handle machinery for list-shaped causal collections.
+
+``CausalList``, ``CausalSet``, and ``CausalCounter`` are all handles
+over the same list-tree core (reference: the deftype protocol surface,
+list.cljc:74-178) — same metadata accessors, same insert/append/weft
+plumbing, and the same three-way pure/native/jax merge dispatch. That
+dispatch is exactly the code that must never diverge between
+collection types (a backend added to one and not the others would
+silently change merge complexity), so it lives here once and each
+concrete class contributes only its rendering and its type-specific
+interop.
+"""
+
+from __future__ import annotations
+
+from . import shared as _s
+
+__all__ = ["ListTreeHandle"]
+
+
+class ListTreeHandle:
+    """Mixin for immutable handles over a list-shaped causal tree.
+
+    Concrete classes define ``__slots__ = ("ct",)``, a ``_fresh``
+    staticmethod returning an empty tree of their type (same weaver),
+    and their own rendering/interop. Every method here returns
+    ``type(self)(...)`` so subclasses stay closed under the shared
+    operations.
+    """
+
+    __slots__ = ()
+
+    def __init__(self, ct):
+        object.__setattr__(self, "ct", ct)
+
+    def __setattr__(self, *a):
+        raise AttributeError(f"{type(self).__name__} is immutable")
+
+    @staticmethod
+    def _fresh(weaver: str):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- CausalMeta (protocols.cljc:3-10) --
+    def get_uuid(self) -> str:
+        return self.ct.uuid
+
+    def get_ts(self) -> int:
+        return self.ct.lamport_ts
+
+    def get_site_id(self) -> str:
+        return self.ct.site_id
+
+    @staticmethod
+    def _weave_fn():
+        # lazy: clist imports this module while defining CausalList
+        from . import clist as _c_list
+
+        return _c_list.weave
+
+    # -- CausalTree protocol (protocols.cljc:12-31) --
+    def get_weave(self):
+        return self.ct.weave
+
+    def get_nodes(self):
+        return self.ct.nodes
+
+    def insert(self, node, more_nodes=None):
+        return type(self)(
+            _s.insert(self._weave_fn(), self.ct, node, more_nodes)
+        )
+
+    def append(self, cause, value):
+        return type(self)(_s.append(self._weave_fn(), self.ct, cause, value))
+
+    def weft(self, ids_to_cut_yarns):
+        return type(self)(
+            _s.weft(self._weave_fn(),
+                    lambda: self._fresh(self.ct.weaver),
+                    self.ct, ids_to_cut_yarns)
+        )
+
+    def merge(self, other):
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return type(self)(jaxw.merge_list_trees(self.ct, other.ct))
+        if self.ct.weaver == "native":
+            from ..weaver import nativew
+
+            return type(self)(nativew.merge_trees(self.ct, other.ct))
+        return type(self)(_s.merge_trees(self._weave_fn(), self.ct, other.ct))
+
+    def merge_many(self, others):
+        """Converge a whole fleet in one pass: N-way node union + one
+        full reweave (the weave is a pure function of the node set, so
+        this equals any fold of pairwise merges). No reference
+        analogue — the reference folds pairwise (shared.cljc:300-314).
+        Under ``weaver="jax"`` the union, validations and reweave are
+        all set-algebra/vectorized/device work — no per-node Python
+        loop."""
+        if self.ct.weaver == "jax":
+            from ..weaver import jaxw
+
+            return type(self)(
+                jaxw.merge_many_list_trees(
+                    [self.ct] + [o.ct for o in others]
+                )
+            )
+        ct = _s.union_nodes_many([self.ct] + [o.ct for o in others])
+        return type(self)(self._weave_fn()(ct))
+
+    # -- IObj/IMeta analogue (list.cljc:97-101) --
+    def with_meta(self, m):
+        return type(self)(self.ct.evolve(meta=m))
+
+    def meta(self):
+        return self.ct.meta
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, type(self)) and self.ct == other.ct
+
+    def __hash__(self) -> int:
+        return hash((self.ct.uuid, self.ct.lamport_ts, self.ct.site_id,
+                     tuple(sorted(self.ct.nodes))))

@@ -1,0 +1,128 @@
+"""Pallas/Mosaic kernels for the contracted (K-wide) weave phases.
+
+The chain-compressed kernels (jaxw.linearize_v2, jaxw3, jaxw4) shrink
+the causal tree to K runs, but still rank the contracted tree with
+log-depth pointer doubling (``jaxw._euler_rank``) — 13 rounds of
+K-wide gathers that TPU profiling showed dominating the residual cost
+(PERF.md): XLA materializes every round as an HBM-width gather pass.
+
+A TPU core walks a K-node tree *sequentially* faster than XLA can
+pointer-double it at batch width: the whole run table fits in VMEM
+(~9 KB at K~2k), a preorder traversal is ~2 visits per run, and each
+visit is a handful of scalar loads — so ``euler_walk`` replaces the
+doubling with one Pallas kernel per replica row (the batch dimension
+arrives via vmap, which maps onto the Pallas grid). Semantics equal
+``_euler_rank``'s weighted preorder base exactly, including the
+convention that unreachable/invalid runs rank at ``total`` (they sort
+behind every kept lane downstream).
+
+CPU runs (tests, the driver dryrun) execute the same kernel in Pallas
+interpret mode — chosen at trace time from the default backend — so
+the suite needs no TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU-only module; absent on CPU-only jaxlibs
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+__all__ = ["euler_walk"]
+
+
+def _interpret() -> bool:
+    """Interpret off-TPU (tests, dryrun); compile via Mosaic on TPU."""
+    return jax.default_backend() != "tpu"
+
+
+def _specs():
+    if pltpu is None:  # pragma: no cover - CPU-only jaxlib
+        any_spec = pl.BlockSpec()
+        return any_spec, any_spec
+    return (pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM))
+
+
+def _euler_walk_kernel(fc_ref, ns_ref, parent_ref, w_ref, total_ref,
+                       base_ref):
+    """Preorder walk of one contracted forest.
+
+    state = (cur, pos, mode): mode 0 visits ``cur`` (stamp base, add
+    its weight, descend to first child), mode 1 retreats (next sibling
+    if any, else climb to parent). One branchless automaton step per
+    iteration; terminates when the retreat climbs past the root (the
+    root's parent is -1). Runs never reached from run 0 (invalid /
+    overflow slots) keep the ``total`` initialization, matching
+    ``_euler_rank``.
+    """
+    K = fc_ref.shape[1]
+    base_ref[...] = jnp.full((1, K), total_ref[0, 0], jnp.int32)
+
+    def cond(state):
+        cur, _pos, _mode, steps = state
+        return (cur >= 0) & (steps < 3 * K + 4)
+
+    def body(state):
+        cur, pos, mode, steps = state
+        is_visit = mode == 0
+
+        @pl.when(is_visit)
+        def _():
+            base_ref[0, cur] = pos
+
+        child = fc_ref[0, cur]
+        sib = ns_ref[0, cur]
+        par = parent_ref[0, cur]
+        npos = jnp.where(is_visit, pos + w_ref[0, cur], pos)
+        ncur = jnp.where(
+            is_visit,
+            jnp.where(child >= 0, child, cur),
+            jnp.where(sib >= 0, sib, par),
+        )
+        nmode = jnp.where(
+            is_visit,
+            jnp.where(child >= 0, 0, 1),
+            jnp.where(sib >= 0, 0, 1),
+        ).astype(jnp.int32)
+        return ncur, npos, nmode, steps + 1
+
+    lax.while_loop(
+        cond, body,
+        (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+    )
+
+
+@functools.partial(jax.jit, static_argnames="k_max")
+def euler_walk(fc, ns, parent_run, run_len, k_max: int):
+    """Weighted preorder base per run, for one row's contracted tree.
+
+    Inputs are the ``[k_max]`` int32 run tables the compressed kernels
+    build (first_child / next_sibling from ``_link_children``, parent
+    run ids with -1 at the root/invalid slots, run lengths with 0 at
+    invalid slots). Returns ``base`` ``[k_max]`` int32. Under ``vmap``
+    the row dimension becomes the Pallas grid.
+    """
+    vmem, smem = _specs()
+    total = jnp.sum(run_len.astype(jnp.int32)).reshape(1, 1)
+    out = pl.pallas_call(
+        _euler_walk_kernel,
+        in_specs=[vmem, vmem, vmem, vmem, smem],
+        out_specs=vmem,
+        out_shape=jax.ShapeDtypeStruct((1, k_max), jnp.int32),
+        interpret=_interpret(),
+    )(
+        fc.reshape(1, k_max),
+        ns.reshape(1, k_max),
+        parent_run.reshape(1, k_max),
+        run_len.astype(jnp.int32).reshape(1, k_max),
+        total,
+    )
+    return out.reshape(k_max)
