@@ -72,7 +72,12 @@ def measure(platform: str) -> dict:
         jax.config.update("jax_platforms", "cpu")
 
     from cause_tpu import benchgen
-    from cause_tpu.benchgen import LANE_KEYS, LANE_KEYS4, merge_wave_scalar
+    from cause_tpu.benchgen import (
+        LANE_KEYS,
+        LANE_KEYS4,
+        LANE_KEYS5,
+        merge_wave_scalar,
+    )
 
     real_platform = jax.devices()[0].platform
     smoke = (
@@ -93,23 +98,33 @@ def measure(platform: str) -> dict:
         k: jax.device_put(batch[k])
         for k in dict.fromkeys(LANE_KEYS + LANE_KEYS4)
     }
+    # v5 segment tables (host-marshalled, like every other lane)
+    v5batch = benchgen.batched_v5_inputs(batch, cap)
+    for k in LANE_KEYS5:
+        if k not in dev:
+            dev[k] = jax.device_put(v5batch[k])
 
     budget = benchgen.pair_run_budget(batch)
+    u_budget = benchgen.v5_token_budget(v5batch)
 
     def step(k: int, kernel: str) -> None:
-        lanes = LANE_KEYS4 if kernel == "v4" else LANE_KEYS
+        lanes = (LANE_KEYS5 if kernel == "v5"
+                 else LANE_KEYS4 if kernel == "v4" else LANE_KEYS)
         args = [dev[name] for name in lanes]
         # one transfer fetches checksum + overflow and forces execution
-        out = np.asarray(merge_wave_scalar(*args, k_max=k, kernel=kernel))
+        out = np.asarray(merge_wave_scalar(
+            *args, k_max=k, kernel=kernel,
+            u_max=k if kernel == "v5" else 0,
+        ))
         if k and out[1]:  # overflowed rows carry garbage ranks
             raise _Overflow()
 
-    # compile + warmup; the fastest kernel (v4 marshal-resolved) first.
-    # No v3 rung: v3/v4 share the run decomposition, so a budget that
-    # overflows v4 is guaranteed to overflow v3 too — fall straight to
-    # the chain-compressed v2 with a doubled budget, then the
-    # uncompressed v1 (k_max=0, cannot overflow).
-    for k_max, kernel in ((budget, "v4"), (2 * budget, "v4"),
+    # compile + warmup; fastest first: the v5 segment-union kernel
+    # (merge cost ~ divergence), then v4 (marshal-resolved causes at
+    # full width), then the chain-compressed v2 with a doubled budget,
+    # then the uncompressed v1 (k_max=0, cannot overflow).
+    for k_max, kernel in ((u_budget, "v5"), (2 * u_budget, "v5"),
+                          (budget, "v4"), (2 * budget, "v4"),
                           (2 * budget, "v2"), (0, "v1")):
         try:
             step(k_max, kernel)

@@ -42,14 +42,25 @@ __all__ = [
     "estimate_pair_runs",
     "pair_run_budget",
     "merge_wave_scalar",
+    "v5_inputs",
+    "batched_v5_inputs",
+    "v5_token_budget",
+    "estimate_tokens",
     "LANE_KEYS",
     "LANE_KEYS4",
+    "LANE_KEYS5",
 ]
 
 LANE_KEYS = ("hi", "lo", "chi", "clo", "vc", "valid")
 # the v4 kernel's lanes: cause ids are replaced by ``cci``, the cause's
 # index in the concatenated pre-sort lane array (known at marshal time)
 LANE_KEYS4 = ("hi", "lo", "cci", "vc", "valid")
+# the v5 segment-union kernel: v4's node lanes + per-lane segment ids
+# + the marshal-extracted segment tables (segments.SEG_LANE_KEYS)
+LANE_KEYS5 = LANE_KEYS4 + (
+    "seg", "sg_min_hi", "sg_min_lo", "sg_max_hi", "sg_max_lo",
+    "sg_len", "sg_lane0", "sg_dense", "sg_tail_special", "sg_valid",
+)
 
 def _union_lanes_np(hi, lo, chi, clo, vc, valid):
     """Numpy twin of the merge kernel's front half (id lexsort, dup
@@ -112,7 +123,8 @@ def pair_run_budget(batch: Dict[str, np.ndarray], sample_rows: int = 4) -> int:
 _scalar_programs: Dict = {}
 
 
-def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
+def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
+                      u_max: int = 0):
     """The shared timed program of the merge benchmarks (bench.py and
     the CLI's config 5): the full batched merge+weave reduced to one
     checksum scalar, because on the axon-tunneled TPU
@@ -122,13 +134,14 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
     ``k_max`` > 0 selects a compressed kernel — ``kernel`` picks which
     ("v2" chain-compressed, "v3" sparse-irregular, "v4"
     marshal-resolved causes, "v4w" = v4 with the sequential Pallas
-    euler walk) — with that run budget, returning a length-2 device
-    array ``[checksum, n_overflowed_rows]`` (one transfer fetches
-    both); ``k_max=0`` runs the uncompressed v1 kernel and returns
-    just the checksum. v1-v3 take the ``LANE_KEYS`` lanes, v4/v4w the
-    ``LANE_KEYS4`` lanes.
+    euler walk, "v5" segment-union with token budget ``u_max``) — with
+    that run budget, returning a length-2 device array ``[checksum,
+    n_overflowed_rows]`` (one transfer fetches both); ``k_max=0`` runs
+    the uncompressed v1 kernel and returns just the checksum. v1-v3
+    take the ``LANE_KEYS`` lanes, v4/v4w the ``LANE_KEYS4`` lanes, v5
+    the ``LANE_KEYS5`` lanes.
     """
-    key = (k_max, kernel if k_max > 0 else "v1")
+    key = (k_max, kernel if k_max > 0 else "v1", u_max)
     program = _scalar_programs.get(key)
     if program is None:
         import functools
@@ -146,7 +159,23 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
                 + jnp.sum(conflict.astype(jnp.float32))
             )
 
-        if k_max > 0:
+        if k_max > 0 and kernel == "v5":
+            from .weaver.jaxw5 import batched_merge_weave_v5
+
+            @jax.jit
+            def program(*a):
+                rank, visible, conflict, overflow = (
+                    batched_merge_weave_v5(
+                        *a, u_max=u_max, k_max=k_max
+                    )
+                )
+                return jnp.stack([
+                    jnp.sum(rank.astype(jnp.float32))
+                    + jnp.sum(visible.astype(jnp.float32))
+                    + jnp.sum(conflict.astype(jnp.float32)),
+                    jnp.sum(overflow.astype(jnp.float32)),
+                ])
+        elif k_max > 0:
             if kernel in ("v4", "v4w"):
                 from .weaver.jaxw4 import batched_merge_weave_v4
 
@@ -177,6 +206,134 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2"):
 
         _scalar_programs[key] = program
     return program(*args)
+
+def v5_inputs(row: Dict[str, np.ndarray], capacity: int,
+              s_max: int = 0) -> Dict[str, np.ndarray]:
+    """Build the v5 segment-union kernel's inputs from a concatenated
+    multi-tree lane row (``capacity`` lanes per tree): segment each
+    tree host-side and assemble the concat segment tables. ``s_max`` 0
+    sizes the tables exactly (padded to a multiple of 8)."""
+    from .weaver.segments import concat_segments, tree_segments
+
+    n_trees = row["hi"].shape[0] // capacity
+    per_tree = []
+    for t in range(n_trees):
+        sl = slice(t * capacity, (t + 1) * capacity)
+        n = int(row["valid"][sl].sum())
+        cci = row["cci"][sl]
+        local_cci = np.where(cci >= 0, cci - t * capacity, -1).astype(
+            np.int32
+        )
+        segs = tree_segments(
+            row["hi"][sl], row["lo"][sl], local_cci, row["vc"][sl], n
+        )
+        per_tree.append((segs, n))
+    total = sum(s["sg_len"].shape[0] for s, _ in per_tree)
+    if not s_max:
+        s_max = total + (-total) % 8
+    out = dict(row)
+    out.update(concat_segments(per_tree, capacity, s_max))
+    return out
+
+
+def batched_v5_inputs(batch: Dict[str, np.ndarray],
+                      capacity: int) -> Dict[str, np.ndarray]:
+    """Per-row ``v5_inputs`` over a [B, n_trees*capacity] batch, with a
+    shared segment-table size (rows marshal once; shorter tables pad
+    with all-invalid tails to the widest row)."""
+    from .weaver.segments import SEG_LANE_KEYS
+
+    B = batch["hi"].shape[0]
+    rows = [
+        v5_inputs({k: batch[k][i] for k in LANE_KEYS4}, capacity)
+        for i in range(B)
+    ]
+    s_max = max(r["sg_len"].shape[0] for r in rows)
+    for r in rows:
+        pad = s_max - r["sg_len"].shape[0]
+        if pad:
+            for k in SEG_LANE_KEYS:
+                r[k] = np.concatenate(
+                    [r[k], np.zeros(pad, r[k].dtype)]
+                )
+    return {k: np.stack([r[k] for r in rows]) for k in LANE_KEYS5}
+
+
+def v5_token_budget(v5batch: Dict[str, np.ndarray],
+                    sample_rows: int = 4) -> int:
+    """Token budget for the v5 kernel, sampled like ``pair_run_budget``
+    (the overflow flag backstops unsampled-row drift)."""
+    B = v5batch["hi"].shape[0] if v5batch["hi"].ndim > 1 else 1
+    if v5batch["hi"].ndim == 1:
+        rows = [v5batch]
+    else:
+        picks = sorted({0, B // 3, (2 * B) // 3, B - 1})[:sample_rows]
+        rows = [{k: v5batch[k][i] for k in LANE_KEYS5} for i in picks]
+    worst = max(estimate_tokens(r) for r in rows)
+    return int(worst + max(64, worst // 8))
+
+
+def estimate_tokens(v5row: Dict[str, np.ndarray]) -> int:
+    """Host-side token count for one v5 row (numpy twin of the
+    kernel's explode/dedupe rules E1/E2) — sizes ``u_max`` before
+    dispatch; the kernel's overflow flag backstops drift."""
+    va = v5row["sg_valid"]
+    mh, ml = v5row["sg_min_hi"][va], v5row["sg_min_lo"][va]
+    Mh, Ml = v5row["sg_max_hi"][va], v5row["sg_max_lo"][va]
+    ln = v5row["sg_len"][va]
+    dense = v5row["sg_dense"][va]
+    tsp = v5row["sg_tail_special"][va]
+    lane0 = v5row["sg_lane0"][va]
+    S = ln.shape[0]
+    if S == 0:
+        return 8
+    mins = (mh.astype(np.int64) << 32) | (ml.astype(np.int64) & 0xFFFFFFFF)
+    maxs = (Mh.astype(np.int64) << 32) | (Ml.astype(np.int64) & 0xFFFFFFFF)
+    order = np.lexsort((ml, mh))
+    mins, maxs = mins[order], maxs[order]
+    ln, dense, tsp, lane0 = (ln[order], dense[order], tsp[order],
+                             lane0[order])
+    ncap = len(v5row["cci"])
+    hvc = v5row["vc"][np.clip(lane0, 0, ncap - 1)]
+    cl0 = v5row["cci"][np.clip(lane0, 0, ncap - 1)]
+    cid0 = np.where(
+        cl0 >= 0,
+        (v5row["hi"][np.clip(cl0, 0, ncap - 1)].astype(np.int64) << 32)
+        | (v5row["lo"][np.clip(cl0, 0, ncap - 1)].astype(np.int64)
+           & 0xFFFFFFFF),
+        -1,
+    )
+    same = np.zeros(S, bool)
+    same[1:] = ((mins[1:] == mins[:-1]) & (maxs[1:] == maxs[:-1])
+                & (ln[1:] == ln[:-1]) & dense[1:] & dense[:-1]
+                & (hvc[1:] == hvc[:-1]) & (cid0[1:] == cid0[:-1]))
+    grp = np.cumsum(~same) - 1
+    g_min = mins[np.concatenate([[True], ~same[1:]])]
+    g_max = maxs[np.concatenate([[True], ~same[1:]])]
+    pm = np.maximum.accumulate(g_max)
+    pm_excl = np.concatenate([[np.iinfo(np.int64).min], pm[:-1]])
+    nxt_min = np.concatenate([g_min[1:], [np.iinfo(np.int64).max]])
+    ov = (mins <= pm_excl[grp]) | (nxt_min[grp] <= maxs)
+    # E2 stabs from every segment head's cause (cid0 packs them above)
+    has = cl0 >= 0
+    cid = cid0
+    pg = np.searchsorted(g_min, cid, side="right") - 1
+    pgc = np.clip(pg, 0, len(g_min) - 1)
+    rep = np.flatnonzero(np.concatenate([[True], ~same[1:]]))
+    stab = (
+        has & (pg >= 0)
+        & (g_min[pgc] <= cid)
+        & ((cid < g_max[pgc])
+           | ((cid == g_max[pgc]) & tsp[rep[pgc]] & (ln[rep[pgc]] > 1)))
+    )
+    stabbed = np.zeros(len(g_min), bool)
+    stabbed[pgc[stab]] = True
+    explode = ov | stabbed[grp]
+    twin_drop = same & ~explode
+    n_tok = int(np.where(explode, ln,
+                         np.where(twin_drop, 0, 1)).sum())
+    return max(8, n_tok)
+
 
 # synthetic site ranks (order-preserving: "0" sorts first, suffix sites
 # are minted after and sort above the base site by construction)

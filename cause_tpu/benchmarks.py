@@ -199,30 +199,46 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
     """Batched device merge of divergent replicas (north-star shape;
     sizes here are CLI defaults — bench.py runs the full 1024x10k).
     ``k_max``: None = workload-derived run budget, 0 = the uncompressed
-    v1 kernel. ``kernel`` picks the compressed kernel ("v4"
-    marshal-resolved causes, the same default bench.py measures, "v3"
-    sparse-irregular, or "v2" chain-compressed); v4 consumes the
-    LANE_KEYS4 lanes, the others LANE_KEYS."""
+    v1 kernel. ``kernel`` picks the compressed kernel ("v5"
+    segment-union, "v4" marshal-resolved causes, "v4w" v4 + Pallas
+    euler walk, "v3" sparse-irregular, or "v2" chain-compressed); v5
+    consumes the LANE_KEYS5 lanes, v4/v4w LANE_KEYS4, the others
+    LANE_KEYS. bench.py's ladder tries v5 then v4."""
     import numpy as _np
 
     import jax
 
-    from .benchgen import LANE_KEYS, LANE_KEYS4, merge_wave_scalar
+    from .benchgen import (
+        LANE_KEYS,
+        LANE_KEYS4,
+        LANE_KEYS5,
+        merge_wave_scalar,
+    )
 
     batch = benchgen.batched_pair_lanes(
         n_replicas=n_replicas, n_base=n_base, n_div=n_div,
         capacity=cap, hide_every=8,
     )
-    lane_names = (
-        LANE_KEYS4 if (kernel in ("v4", "v4w") and k_max != 0) else LANE_KEYS
-    )
+    u_max = 0
+    if kernel == "v5" and k_max != 0:
+        batch = dict(batch, **benchgen.batched_v5_inputs(batch, cap))
+        lane_names = LANE_KEYS5
+        u_max = benchgen.v5_token_budget(batch)
+        if k_max is None:
+            k_max = u_max
+    else:
+        lane_names = (
+            LANE_KEYS4 if (kernel in ("v4", "v4w") and k_max != 0)
+            else LANE_KEYS
+        )
+        if k_max is None:
+            k_max = benchgen.pair_run_budget(batch)
     args = [jax.device_put(batch[k]) for k in lane_names]
-    if k_max is None:
-        k_max = benchgen.pair_run_budget(batch)
 
     def step():
         out = _np.asarray(
-            merge_wave_scalar(*args, k_max=k_max, kernel=kernel)
+            merge_wave_scalar(*args, k_max=k_max, kernel=kernel,
+                              u_max=u_max)
         )
         if k_max and out.shape and out[1]:
             raise RuntimeError("run budget overflow — raise k_max")

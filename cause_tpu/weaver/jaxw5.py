@@ -1,0 +1,537 @@
+"""The v5 segment-union merge kernel: merge cost scales with divergence.
+
+Every kernel so far (v1-v4) pays full node width for the union sort
+and the flag/scan passes even though replicas of a shared document are
+IDENTICAL over almost all of it. v5 moves the union to *segment*
+granularity (per-tree chain runs, marshal-extracted by
+``segments.tree_segments``) and only explodes a segment to node
+tokens where replicas actually interact:
+
+E1. its id interval overlaps another segment's (divergent edits
+    interleave), unless the two are exact dense twins (the shared
+    root/base prefix every replica carries — those dedupe wholesale,
+    exactly: a dense segment's member ids are fully determined by
+    (min, max, len));
+E2. some other segment head's *cause* stabs its interior — including
+    its tail when the tail is special with members before it, because
+    the host jump of an external child would walk through the tail
+    into the interior and split the run there (the v4 contested rule).
+
+Everything that survives rides the union as ONE sort token carrying
+its length; exploded segments contribute one token per lane. The
+union pipeline is then exactly jaxw4's — adjacency, host-case, glue,
+contested, chain runs, sibling sort, Euler ranking — run at token
+width (~divergence size) with token lengths as weights, and the final
+per-lane ranks/visibility materialize over the full lane width with
+only elementwise passes, cumulative scans, and small scatters: no
+full-width sort, gather, or binary search anywhere.
+
+For the north-star shape (1024 pairs x 10k nodes, ~2k-node divergence)
+that removes ~95% of the full-width work v4 still did. For a single
+tree (the API reweave path) nothing explodes and the device work is
+just the segment ordering. Semantics remain EXACT vs the pure oracle
+and v1 (tests/test_jax_v5.py); like v2-v4 the kernel takes static
+budgets (``s`` is the table size, ``u_max`` tokens, ``k_max`` runs)
+and raises an overflow flag instead of corrupting.
+
+Caveat (documented divergence from v4's diagnostics, not semantics):
+wholesale-deduped twin segments skip the per-node body comparison, so
+the ``conflict`` flag only covers exploded/duplicated tokens — the
+API paths validate bodies host-side anyway (shared.union_nodes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .arrays import I32_MAX, VCLASS_H_HIDE, VCLASS_HIDE
+from .jaxw import _euler_rank, _link_children
+from .jaxw3 import _shift1
+
+__all__ = [
+    "merge_weave_kernel_v5",
+    "batched_merge_weave_v5",
+]
+
+
+def _lt(a1, a2, b1, b2):
+    return (a1 < b1) | ((a1 == b1) & (a2 < b2))
+
+
+def _le(a1, a2, b1, b2):
+    return (a1 < b1) | ((a1 == b1) & (a2 <= b2))
+
+
+def _eq(a1, a2, b1, b2):
+    return (a1 == b1) & (a2 == b2)
+
+
+def _pair_cummax(hi, lo):
+    """Inclusive running lexicographic max over (hi, lo) pairs."""
+
+    def op(a, b):
+        ah, al = a
+        bh, bl = b
+        take_b = _lt(ah, al, bh, bl)
+        return jnp.where(take_b, bh, ah), jnp.where(take_b, bl, al)
+
+    return lax.associative_scan(op, (hi, lo))
+
+
+def _pair_search_le(kh, kl, qh, ql, size):
+    """For each query id, the rightmost index i in the sorted (kh, kl)
+    arrays with key[i] <= query (-1 if none): a fori binary search at
+    query width."""
+    steps = 1
+    while (1 << steps) < size + 1:
+        steps += 1
+
+    def body(_, c):
+        lo_b, hi_b = c
+        mid = (lo_b + hi_b + 1) // 2  # invariant: key[lo_b] <= q
+        ms = jnp.clip(mid, 0, size - 1)
+        ok = _le(kh[ms], kl[ms], qh, ql)
+        return jnp.where(ok, mid, lo_b), jnp.where(ok, hi_b, mid - 1)
+
+    lo_b, _ = lax.fori_loop(
+        0, steps, body,
+        (jnp.full_like(qh, -1), jnp.full_like(qh, size - 1)),
+    )
+    return lo_b
+
+
+def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
+                          sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
+                          sg_len, sg_lane0, sg_dense, sg_tail_special,
+                          sg_valid, u_max: int, k_max: int):
+    """Union + reweave at segment granularity for one replica set.
+
+    Node lanes as in v4 (``hi/lo/cci/vclass/valid`` — trees
+    concatenated, each id-sorted) plus ``seg`` (each lane's segment
+    ordinal) and the ``SEG_LANE_KEYS`` tables in ascending-lane marshal
+    order. Returns ``(rank, visible, conflict, overflow)`` with rank
+    and visibility indexed by CONCAT lane (not by sorted position —
+    there is no full-width sorted order here).
+    """
+    N = hi.shape[0]
+    S = sg_len.shape[0]
+    sidx = jnp.arange(S, dtype=jnp.int32)
+    BIG = I32_MAX
+
+    # ================= A. segment ordering + explode/dedupe =========
+    kh = jnp.where(sg_valid, sg_min_hi, BIG)
+    kl = jnp.where(sg_valid, sg_min_lo, BIG)
+    s_mh, s_ml, s_src = lax.sort((kh, kl, sidx), num_keys=2)
+    s_Mh = sg_max_hi[s_src]
+    s_Ml = sg_max_lo[s_src]
+    s_len = jnp.where(sg_valid[s_src], sg_len[s_src], 0)
+    s_lane0 = sg_lane0[s_src]
+    s_dense = sg_dense[s_src]
+    s_tsp = sg_tail_special[s_src]
+    s_va = sg_valid[s_src]
+
+    # head body fields (shared by the twin test and the E2 stabs)
+    s_hvc = vclass[jnp.clip(s_lane0, 0, N - 1)]
+    c_lane = cci[jnp.clip(s_lane0, 0, N - 1)]
+    has_c = s_va & (c_lane >= 0)
+    c_hi = jnp.where(has_c, hi[jnp.clip(c_lane, 0, N - 1)], -1)
+    c_lo = jnp.where(has_c, lo[jnp.clip(c_lane, 0, N - 1)], -1)
+
+    # twin groups: adjacent exact-equal dense segments dedupe wholesale.
+    # Equality covers the endpoints, length, density, the head's value
+    # class, and the head's cause id — a same-id segment with a
+    # different head body fails the test, overlaps, explodes, and the
+    # node-level duplicate check reports the conflict. (Interior bodies
+    # of multi-lane twins stay uncompared — see the module caveat.)
+    p_mh, p_ml = _shift1(s_mh, -1), _shift1(s_ml, -1)
+    same_prev = (
+        _eq(s_mh, s_ml, p_mh, p_ml)
+        & _eq(s_Mh, s_Ml, _shift1(s_Mh, -1), _shift1(s_Ml, -1))
+        & (s_len == _shift1(s_len, -1))
+        & s_dense & _shift1(s_dense, False)
+        & (s_hvc == _shift1(s_hvc, -1))
+        & _eq(c_hi, c_lo, _shift1(c_hi, -1), _shift1(c_lo, -1))
+        & s_va & _shift1(s_va, False)
+        & (sidx > 0)
+    )
+    grp_start = ~same_prev
+    grp = jnp.cumsum(grp_start.astype(jnp.int32)) - 1
+    n_grp = grp[-1] + 1
+
+    # per-group interval tables (twins share min/max by construction)
+    gsl = jnp.where(grp_start & s_va, grp, S - 1)
+    g_mh = jnp.full(S, BIG, jnp.int32).at[gsl].set(
+        jnp.where(grp_start & s_va, s_mh, BIG), mode="drop")
+    g_ml = jnp.full(S, BIG, jnp.int32).at[gsl].set(
+        jnp.where(grp_start & s_va, s_ml, BIG), mode="drop")
+    g_Mh = jnp.full(S, -1, jnp.int32).at[gsl].set(
+        jnp.where(grp_start & s_va, s_Mh, -1), mode="drop")
+    g_Ml = jnp.full(S, -1, jnp.int32).at[gsl].set(
+        jnp.where(grp_start & s_va, s_Ml, -1), mode="drop")
+
+    # E1: overlap with any earlier group (prefix pair-max of maxes,
+    # exclusive) or the next group (its min is the smallest later min)
+    pmh, pml = _pair_cummax(g_Mh, g_Ml)
+    pmh_e, pml_e = _shift1(pmh, -1), _shift1(pml, -1)
+    gi = jnp.clip(grp, 0, S - 1)
+    ov_before = _le(s_mh, s_ml, pmh_e[gi], pml_e[gi])
+    nxt_mh = jnp.concatenate([g_mh[1:], jnp.full((1,), BIG, jnp.int32)])
+    nxt_ml = jnp.concatenate([g_ml[1:], jnp.full((1,), BIG, jnp.int32)])
+    ov_after = _le(nxt_mh[gi], nxt_ml[gi], s_Mh, s_Ml)
+    explode = s_va & (ov_before | ov_after)
+
+    # E2: head-cause stabs. Candidate = rightmost group with min <= c.
+    pg = _pair_search_le(g_mh, g_ml, c_hi, c_lo, S)
+    pgc = jnp.clip(pg, 0, S - 1)
+    # group tables for the stabbed group: len/tail-specialness of its
+    # representative member (first of group; twins agree)
+    rep = jnp.full(S, 0, jnp.int32).at[gsl].set(
+        jnp.where(grp_start & s_va, sidx, 0), mode="drop")
+    r_len = s_len[rep[pgc]]
+    r_tsp = s_tsp[rep[pgc]]
+    stab = has_c & (pg >= 0) & _le(g_mh[pgc], g_ml[pgc], c_hi, c_lo) & (
+        _lt(c_hi, c_lo, g_Mh[pgc], g_Ml[pgc])
+        | (_eq(c_hi, c_lo, g_Mh[pgc], g_Ml[pgc]) & r_tsp & (r_len > 1))
+    )
+    g_stabbed = jnp.zeros(S, bool).at[
+        jnp.where(stab, pgc, S - 1)
+    ].set(True, mode="drop")
+    # make the last slot honest (it may have been used as a dump)
+    g_stabbed = g_stabbed.at[S - 1].set(
+        jnp.any(stab & (pgc == S - 1)))
+    explode = explode | (s_va & g_stabbed[gi])
+
+    twin_drop = same_prev & ~explode
+    survive = s_va & ~explode & ~twin_drop
+
+    # ================= B. token construction ========================
+    tok_cnt = jnp.where(survive, 1, jnp.where(s_va & explode, s_len, 0))
+    tc_cum = jnp.cumsum(tok_cnt)
+    tb = tc_cum - tok_cnt  # exclusive: first token slot per sorted seg
+    n_tok = tc_cum[-1]
+    U = u_max
+    uidx = jnp.arange(U, dtype=jnp.int32)
+    u_ok = uidx < jnp.minimum(n_tok, U)
+    overflow_u = n_tok > U
+
+    owner = jnp.searchsorted(tc_cum, uidx, side="right").astype(jnp.int32)
+    oc = jnp.clip(owner, 0, S - 1)
+    off = uidx - tb[oc]
+    o_expl = s_va[oc] & (~survive[oc])
+    t_lane = jnp.clip(
+        s_lane0[oc] + jnp.where(o_expl, off, 0), 0, N - 1
+    )
+    t_hi = jnp.where(u_ok, hi[t_lane], BIG)
+    t_lo = jnp.where(u_ok, lo[t_lane], BIG)
+    t_len = jnp.where(u_ok, jnp.where(o_expl, 1, s_len[oc]), 0)
+    t_vc = jnp.where(u_ok, vclass[t_lane], 0)
+    t_tail_lane = t_lane + t_len - 1
+    t_tsp = jnp.where(
+        o_expl, t_vc > 0, s_tsp[oc]
+    ) & u_ok
+
+    # token_of_lane machinery (PRESORT token ids). A cause lane inside
+    # a twin-DROPPED segment copy (tree B's own copy of the shared
+    # base) must resolve to the KEPT twin's token: group-start fill
+    # gsp redirects any twin member to its group's first (kept) member.
+    inv_s = jnp.zeros(S, jnp.int32).at[s_src].set(sidx)
+    seg_expl_sorted = s_va & explode
+    gsp = lax.cummax(jnp.where(grp_start, sidx, -1))
+
+    def token_of_lane(p):
+        pc = jnp.clip(p, 0, N - 1)
+        m = jnp.clip(seg[pc], 0, S - 1)
+        ss2 = inv_s[m]
+        ex = seg_expl_sorted[ss2]
+        owner_ss = jnp.where(ex, ss2, gsp[ss2])
+        return (tb[owner_ss]
+                + jnp.where(ex, pc - sg_lane0[m], 0)).astype(jnp.int32)
+
+    # ================= C. sort tokens, dedupe =======================
+    su_src_in = uidx
+    st_hi, st_lo, t_src = lax.sort((t_hi, t_lo, su_src_in), num_keys=2)
+    inv_t = jnp.zeros(U, jnp.int32).at[t_src].set(uidx)
+    g = lambda arr: arr[t_src]  # presort field -> sorted order
+    sv_len, sv_vc, sv_tsp = g(t_len), g(t_vc), g(t_tsp)
+    sv_lane, sv_tail_lane = g(t_lane), g(t_tail_lane)
+
+    tva = ~((st_hi == BIG) & (st_lo == BIG))
+    sdup = (
+        _eq(st_hi, st_lo, _shift1(st_hi, -1), _shift1(st_lo, -1))
+        & (uidx > 0) & tva
+    )
+    keep_t = tva & ~sdup
+
+    # ================= D. token cause resolution ====================
+    cl = jnp.where(tva, cci[jnp.clip(sv_lane, 0, N - 1)], -1)
+    cause_u = token_of_lane(cl)
+    cause_su_raw = inv_t[jnp.clip(cause_u, 0, U - 1)]
+    # redirect to the kept head of a duplicate token group: dups are
+    # adjacent after the sort, so a kept-head fill redirects them
+    thead = lax.cummax(jnp.where(keep_t, uidx, -1))
+    cause_su = jnp.where(
+        cl >= 0, thead[jnp.clip(cause_su_raw, 0, U - 1)], 0
+    ).astype(jnp.int32)
+
+    special_t = keep_t & (sv_vc > 0)
+    is_root_t = keep_t & (uidx == 0)
+    rel_t = keep_t & ~is_root_t
+
+    # host walk (lane-level, at token width): first non-special lane
+    # on the cause chain
+    def wcond(c):
+        p, i = c
+        pc = jnp.clip(p, 0, N - 1)
+        on = rel_t & ~special_t & (p >= 0) & (vclass[pc] > 0)
+        return (i < N) & jnp.any(on)
+
+    def wbody(c):
+        p, i = c
+        pc = jnp.clip(p, 0, N - 1)
+        on = rel_t & ~special_t & (p >= 0) & (vclass[pc] > 0)
+        return jnp.where(on, cci[pc], p), i + 1
+
+    host_lane, _ = lax.while_loop(wcond, wbody, (cl, jnp.int32(0)))
+    host_su = jnp.where(
+        host_lane >= 0,
+        thead[jnp.clip(inv_t[jnp.clip(token_of_lane(host_lane), 0, U - 1)],
+                       0, U - 1)],
+        0,
+    ).astype(jnp.int32)
+    parent_su = jnp.where(special_t, cause_su, host_su)
+
+    conflict = jnp.any(
+        sdup & (
+            (sv_vc != _shift1(sv_vc, 0))
+            | (cause_su != _shift1(cause_su, 0))
+            | (sv_len != _shift1(sv_len, 0))
+        )
+    )
+
+    # ================= E. v4 pipeline at token width ================
+    wcum = jnp.cumsum(jnp.where(keep_t, sv_len, 0))
+    wstart = wcum - jnp.where(keep_t, sv_len, 0)
+    n_kept_nodes = wcum[-1]
+
+    sp_pack = lax.cummax(
+        jnp.where(keep_t, uidx * 2 + sv_tsp.astype(jnp.int32), -1)
+    )
+    sp_prev = _shift1(sp_pack, -1)
+    prev_kept = jnp.where(sp_prev >= 0, sp_prev >> 1, -1)
+    prev_kept_tsp = (sp_prev >= 0) & (sp_prev % 2 == 1)
+
+    adj = rel_t & (cause_su == prev_kept) & (prev_kept >= 0)
+    host_case = adj & ~special_t & prev_kept_tsp
+    irregular = rel_t & (~adj | host_case)
+
+    extra = jnp.zeros(U, jnp.int32).at[
+        jnp.where(irregular, parent_su, U - 1)
+    ].add(1, mode="drop")
+    extra = extra.at[U - 1].set(
+        jnp.sum(jnp.where(irregular & (parent_su == U - 1), 1, 0)))
+    ec_pack = lax.cummax(
+        jnp.where(keep_t, uidx * 2 + (extra > 0).astype(jnp.int32), -1)
+    )
+    ec_prev = _shift1(ec_pack, -1)
+    prev_contested = (ec_prev >= 0) & (ec_prev % 2 == 1)
+    glued = adj & ~host_case & ~prev_contested
+
+    run_start = keep_t & ~glued
+    rs_cum = jnp.cumsum(run_start.astype(jnp.int32))
+    run_id = rs_cum - 1
+    n_runs = rs_cum[-1]
+    overflow_k = n_runs > k_max
+
+    targets = jnp.arange(1, k_max + 1, dtype=jnp.int32)
+    head_tok = jnp.searchsorted(rs_cum, targets, side="left").astype(
+        jnp.int32
+    )
+    r_valid = targets <= jnp.minimum(n_runs, k_max)
+    hc = jnp.clip(head_tok, 0, U - 1)
+
+    h_parent = jnp.where(
+        irregular[hc], parent_su[hc],
+        jnp.where(adj[hc], prev_kept[hc], -1),
+    )
+    h_parent = jnp.where(r_valid & ~is_root_t[hc], h_parent, -1)
+    parent_run = jnp.where(
+        h_parent >= 0, run_id[jnp.clip(h_parent, 0, U - 1)], -1
+    ).astype(jnp.int32)
+
+    h_special = special_t[hc]
+    h_w = wstart[hc]
+    nxt_w = jnp.concatenate([h_w[1:], h_w[:1]])
+    run_w = jnp.where(
+        r_valid,
+        jnp.where(targets == n_runs, n_kept_nodes - h_w, nxt_w - h_w),
+        0,
+    ).astype(jnp.int32)
+
+    parent_sort = jnp.where(r_valid & (parent_run >= 0), parent_run, k_max)
+    packed = parent_sort * 2 + (~h_special).astype(jnp.int32)
+    sord = jnp.lexsort((-hc, packed))
+    fc, ns = _link_children(sord, parent_sort)
+    parent_up = jnp.where(r_valid & (parent_run >= 0), parent_run, -1)
+    base_run, _ = _euler_rank(fc, ns, parent_up, run_w)
+
+    # expand run bases to token bases (node units): delta-scatter at
+    # run-head tokens + one cumsum over U, then add within-run offset
+    delta = jnp.where(
+        r_valid,
+        base_run - jnp.concatenate([jnp.zeros((1,), base_run.dtype),
+                                    base_run[:-1]]),
+        0,
+    )
+    delta_u = jnp.zeros(U, jnp.int32).at[
+        jnp.where(r_valid, hc, U - 1)
+    ].set(delta.astype(jnp.int32), mode="drop")
+    last_fix = jnp.sum(jnp.where(r_valid & (hc == U - 1), delta, 0))
+    delta_u = delta_u.at[U - 1].set(last_fix.astype(jnp.int32))
+    base_ff = jnp.cumsum(delta_u)
+    ffw = lax.cummax(jnp.where(run_start, wstart, -1))
+    rank_tok = jnp.where(
+        keep_t, base_ff + (wstart - ffw), N
+    ).astype(jnp.int32)
+
+    # -------- token-level kills (victims as lanes) ------------------
+    hideish = (sv_vc == VCLASS_HIDE) | (sv_vc == VCLASS_H_HIDE)
+    kg = glued & hideish
+    vict_inrun = jnp.where(
+        kg, sv_tail_lane[jnp.clip(prev_kept, 0, U - 1)], N
+    )
+
+    # preorder-successor run: the run with the next-larger base. base
+    # values are node-unit positions (up to N), so find successors by
+    # sorting runs on base instead of scattering over node positions.
+    kidx_r = jnp.arange(k_max, dtype=jnp.int32)
+    bkey = jnp.where(r_valid, base_run, BIG)
+    b_sorted, b_src = lax.sort((bkey, kidx_r), num_keys=1)
+    succ_in_sorted = jnp.concatenate([
+        b_src[1:], jnp.full((1,), -1, jnp.int32)
+    ])
+    succ_valid = jnp.concatenate([
+        b_sorted[1:] != BIG, jnp.zeros((1,), bool)
+    ])
+    succ_of = jnp.full(k_max, -1, jnp.int32).at[b_src].set(
+        jnp.where(succ_valid, succ_in_sorted, -1)
+    )
+    succ_run = jnp.where(r_valid, succ_of, -1)
+    s_c = jnp.clip(
+        jnp.where(succ_run >= 0, hc[jnp.clip(succ_run, 0, k_max - 1)], 0),
+        0, U - 1,
+    )
+    s_is_hide = (succ_run >= 0) & hideish[s_c]
+    nxt_head = jnp.concatenate([hc[1:], hc[:1]])
+    tail_tok = jnp.where(
+        targets == n_runs,
+        jnp.maximum(sp_pack[-1] >> 1, 0),
+        prev_kept[jnp.clip(nxt_head, 0, U - 1)],
+    ).astype(jnp.int32)
+    t_cc = jnp.clip(tail_tok, 0, U - 1)
+    # succ head's cause must BE the run's tail node — compared at
+    # token level (cause_su is duplicate-redirected; a hide arriving
+    # from another replica names its own dropped copy of the tail)
+    kill_tail = r_valid & s_is_hide & (cause_su[s_c] == tail_tok)
+    vict_tail = jnp.where(kill_tail, sv_tail_lane[t_cc], N)
+
+    # ================= F. expansion to concat lanes =================
+    # token base + token lane, in LANE order (sort tokens by lane) so
+    # deltas scatter + cumsum reconstructs per-lane values without any
+    # full-width gather
+    lane_key = jnp.where(keep_t & (rank_tok < N), sv_lane, N)
+    lk, tok_at = lax.sort((lane_key, uidx), num_keys=1)
+    tb_l = rank_tok[tok_at]
+    tl_l = jnp.where(lane_key[tok_at] < N, lane_key[tok_at], 0)
+    ok_l = lane_key[tok_at] < N
+    d_base = jnp.where(
+        ok_l,
+        tb_l - jnp.concatenate([jnp.zeros((1,), jnp.int32), tb_l[:-1]]),
+        0,
+    )
+    d_lane = jnp.where(
+        ok_l,
+        tl_l - jnp.concatenate([jnp.zeros((1,), jnp.int32), tl_l[:-1]]),
+        0,
+    )
+    scat = jnp.where(ok_l, tl_l, N)
+    base_n = jnp.zeros(N, jnp.int32).at[scat].add(d_base, mode="drop")
+    lane_n = jnp.zeros(N, jnp.int32).at[scat].add(d_lane, mode="drop")
+    has_tok = jnp.zeros(N, bool).at[scat].set(True, mode="drop")
+    base_fill = jnp.cumsum(base_n)
+    lane_fill = jnp.cumsum(lane_n)
+    lane_idx = jnp.arange(N, dtype=jnp.int32)
+
+    # per-lane coverage flags from the segment tables (marshal order =
+    # ascending lane order): covered = lane belongs to a token that is
+    # kept, either via its own token (exploded) or its segment's token
+    cov_cnt = jnp.zeros(N + 1, jnp.int32)
+    seg_cov = sg_valid & survive[inv_s]
+    cov_cnt = cov_cnt.at[
+        jnp.where(seg_cov, sg_lane0, N)
+    ].add(1, mode="drop")
+    cov_cnt = cov_cnt.at[
+        jnp.where(seg_cov, sg_lane0 + sg_len, N)
+    ].add(-1, mode="drop")
+    in_surviving = jnp.cumsum(cov_cnt[:N]) > 0
+
+    # surviving-segment lanes take the seg token's base + offset (their
+    # own has_tok is only set at the head lane — the fill carries it);
+    # exploded lanes have their own token scatter; everything else
+    # (padding, dropped twins, duplicate tokens) ranks at N
+    rank_lane = jnp.where(
+        valid & (in_surviving | has_tok),
+        base_fill + (lane_idx - lane_fill),
+        N,
+    ).astype(jnp.int32)
+
+    # visibility
+    hideish_l = (vclass == VCLASS_HIDE) | (vclass == VCLASS_H_HIDE)
+    nxt_same_seg = jnp.concatenate([
+        (seg[1:] == seg[:N - 1]) & (seg[:N - 1] >= 0),
+        jnp.zeros((1,), bool),
+    ])
+    nxt_hide = jnp.concatenate([hideish_l[1:], jnp.zeros((1,), bool)])
+    kill_in_seg = in_surviving & nxt_same_seg & nxt_hide
+
+    killed = jnp.zeros(N + 1, bool)
+    killed = killed.at[jnp.where(kg, vict_inrun, N)].set(True, mode="drop")
+    killed = killed.at[jnp.where(kill_tail, vict_tail, N)].set(
+        True, mode="drop")
+    killed = killed[:N] | kill_in_seg
+
+    root_lane = jnp.zeros(N, bool).at[
+        jnp.clip(sv_lane[0], 0, N - 1)
+    ].set(keep_t[0])
+
+    visible = (
+        valid & (rank_lane < N) & (vclass == 0) & ~root_lane & ~killed
+    )
+    overflow = overflow_u | overflow_k
+    return rank_lane, visible, conflict, overflow
+
+
+merge_weave_kernel_v5_jit = jax.jit(
+    merge_weave_kernel_v5, static_argnames=("u_max", "k_max")
+)
+
+
+@partial(jax.jit, static_argnames=("u_max", "k_max"))
+def batched_merge_weave_v5(hi, lo, cci, vclass, valid, seg,
+                           sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
+                           sg_len, sg_lane0, sg_dense, sg_tail_special,
+                           sg_valid, u_max: int, k_max: int):
+    """Segment-union batch: [B, N] node lanes + [B, S] segment tables
+    -> per-replica (rank, visible, conflict, overflow), rank/visible
+    indexed by concat lane."""
+
+    def row(*a):
+        return merge_weave_kernel_v5(*a, u_max=u_max, k_max=k_max)
+
+    return jax.vmap(row)(hi, lo, cci, vclass, valid, seg,
+                         sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
+                         sg_len, sg_lane0, sg_dense, sg_tail_special,
+                         sg_valid)

@@ -1,0 +1,201 @@
+"""Marshal-side segment extraction for the v5 segment-union kernel.
+
+A causal tree's chain-run structure is a *static per-tree fact*: runs
+are maximal stretches of lanes where each node's cause is the previous
+lane and the v4 glue rules hold locally (no host-case, parent not
+contested). ``NodeArrays`` lanes are id-sorted, so every run is a
+contiguous lane range — which means a merge can treat a whole run as
+ONE sort token whenever nothing foreign intrudes on it, and only
+explode to node granularity where replicas actually diverged. That is
+the right asymptotic for a CRDT: merge cost scales with the
+divergence, not the document size (the reference pays O(n*m) on the
+whole tree, shared.cljc:300-314).
+
+This module computes, per tree, host-side (vectorized numpy — one pass
+over the lanes, same cost class as building the lanes themselves):
+
+- ``run_of_lane``: each lane's segment ordinal;
+- per-segment tables: head lane, length, head id (= min id), tail id
+  (= max id), a *dense* flag (ids are consecutive-ts, same-site, tx 0 —
+  exactly the shape ``conj``/``extend`` chains mint), and whether the
+  tail is special (trailing tombstone chain);
+- the root is always forced into its own singleton segment so the
+  root+base prefix shared by every replica stays wholesale-dedupable
+  (the root id's packed lo differs from the chain site's, which would
+  otherwise break the dense test).
+
+Segmentation MUST mirror ``jaxw4``'s local glue semantics exactly —
+the device kernel re-glues *tokens* with the same rules, so local runs
+have to be unions of v4 runs for the expansion to agree. The
+correspondence is fuzz-tested against the device kernels.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["tree_segments", "concat_segments", "SEG_KEYS", "SEG_LANE_KEYS"]
+
+SEG_KEYS = (
+    "sg_head_lane",  # lane of the segment head (tree coordinates)
+    "sg_len",        # member count
+    "sg_min_hi", "sg_min_lo",   # head id (the minimum member id)
+    "sg_max_hi", "sg_max_lo",   # tail id (the maximum member id)
+    "sg_dense",      # ids are (hi..hi+len-1, constant lo): exact-dedupe ok
+    "sg_tail_special",  # tail lane carries a special (tombstone suffix)
+)
+
+# the device kernel's segment-table lanes (concat coordinates, padded)
+SEG_LANE_KEYS = (
+    "sg_min_hi", "sg_min_lo", "sg_max_hi", "sg_max_lo",
+    "sg_len", "sg_lane0", "sg_dense", "sg_tail_special", "sg_valid",
+)
+
+
+def tree_segments(hi, lo, cause_idx, vclass, n: int) -> Dict[str, np.ndarray]:
+    """Segment one tree's lanes (ascending id order, lane 0 = root).
+
+    Returns ``run_of_lane`` ([capacity] int32, -1 beyond ``n``) plus the
+    ``SEG_KEYS`` tables (length = number of segments). Mirrors the v4
+    union kernel's glue computation restricted to a single tree:
+    ``glued[i] = adj & ~host_case & ~contested[i-1]`` with parents
+    resolved through the special-chain host jump.
+    """
+    cap = hi.shape[0]
+    run_of_lane = np.full(cap, -1, np.int32)
+    if n <= 0:
+        return {
+            "run_of_lane": run_of_lane,
+            **{k: np.zeros(0, np.int32) for k in SEG_KEYS},
+        }
+
+    idx = np.arange(n, dtype=np.int32)
+    special = vclass[:n] > 0
+    adj = np.zeros(n, bool)
+    adj[1:] = cause_idx[1:n] == idx[:-1]
+    host_case = adj & ~special
+    host_case[1:] &= special[:-1]
+    host_case[0] = False
+    irregular = (idx > 0) & (~adj | host_case)
+
+    # local parents: specials hang off their cause, non-specials off the
+    # first non-special ancestor through the cause chain
+    cs = np.clip(cause_idx[:n], 0, n - 1)
+    host = cs.copy()
+    for _ in range(max(1, math.ceil(math.log2(max(2, n))))):
+        on_special = special[host] & (idx > 0)
+        if not on_special.any():
+            break
+        host = np.where(on_special, host[host], host)
+    parent = np.where(idx > 0, np.where(special, cs, host), -1)
+
+    # contested: lanes that parent at least one irregular child
+    contested = np.zeros(n, bool)
+    ip = parent[irregular]
+    contested[ip[ip >= 0]] = True
+
+    glued = adj & ~host_case
+    glued[1:] &= ~contested[:-1]
+    glued[0] = False
+    # split at density breaks (site change or ts jump): the dedupable
+    # unit is the dense run, and density breaks are exactly where a
+    # shared prefix flows into site-local edits — without the split,
+    # the shared base would glue into the divergent suffix and lose
+    # its wholesale-dedupe (the union kernel re-glues tokens, so extra
+    # boundaries never change the final weave)
+    dense_ok = np.ones(n, bool)
+    dense_ok[1:] = (lo[1:n] == lo[: n - 1]) & (hi[1:n] == hi[: n - 1] + 1)
+    glued &= dense_ok
+    # the root is always a singleton segment (its packed lo differs
+    # from any chain site's, so a root-headed run could never be dense)
+    if n > 1:
+        glued[1] = False
+
+    run_start = ~glued
+    rid = np.cumsum(run_start).astype(np.int32) - 1
+    run_of_lane[:n] = rid
+    n_runs = int(rid[-1]) + 1
+
+    head_lane = np.flatnonzero(run_start).astype(np.int32)
+    nxt = np.concatenate([head_lane[1:], np.int32([n])])
+    sg_len = (nxt - head_lane).astype(np.int32)
+    tail_lane = nxt - 1
+
+    sg_min_hi = hi[:n][head_lane].astype(np.int32)
+    sg_min_lo = lo[:n][head_lane].astype(np.int32)
+    sg_max_hi = hi[:n][tail_lane].astype(np.int32)
+    sg_max_lo = lo[:n][tail_lane].astype(np.int32)
+
+    # dense: constant lo along the run and hi advancing by exactly 1.
+    # The density-break glue split makes every multi-lane run dense by
+    # construction; keep the aggregate check anyway (robustness against
+    # a future glue-rule change silently losing the invariant)
+    bad = ~dense_ok & ~run_start  # the head lane never breaks its run
+    bad_runs = np.zeros(n_runs, bool)
+    bad_runs[rid[bad]] = True
+    sg_dense = ~bad_runs
+
+    sg_tail_special = special[tail_lane]
+
+    return {
+        "run_of_lane": run_of_lane,
+        "sg_head_lane": head_lane,
+        "sg_len": sg_len,
+        "sg_min_hi": sg_min_hi,
+        "sg_min_lo": sg_min_lo,
+        "sg_max_hi": sg_max_hi,
+        "sg_max_lo": sg_max_lo,
+        "sg_dense": sg_dense.astype(bool),
+        "sg_tail_special": sg_tail_special.astype(bool),
+    }
+
+
+def concat_segments(per_tree, capacity: int, s_max: int) -> Dict[str, np.ndarray]:
+    """Assemble per-tree segment tables into the device kernel's concat
+    layout: ``per_tree`` is a list of (``tree_segments`` result, n)
+    tuples, each tree occupying ``capacity`` concat lanes in order.
+
+    Returns the ``SEG_LANE_KEYS`` arrays padded to ``s_max`` (in lane
+    order — marshal order IS ascending concat lane order, which the
+    kernel's expansion scans rely on) plus ``seg`` ([n_trees*capacity]
+    int32): every concat lane's segment ordinal (-1 padding).
+    """
+    n_trees = len(per_tree)
+    out = {
+        "sg_min_hi": np.full(s_max, 0, np.int32),
+        "sg_min_lo": np.full(s_max, 0, np.int32),
+        "sg_max_hi": np.full(s_max, 0, np.int32),
+        "sg_max_lo": np.full(s_max, 0, np.int32),
+        "sg_len": np.zeros(s_max, np.int32),
+        "sg_lane0": np.zeros(s_max, np.int32),
+        "sg_dense": np.zeros(s_max, bool),
+        "sg_tail_special": np.zeros(s_max, bool),
+        "sg_valid": np.zeros(s_max, bool),
+    }
+    seg = np.full(n_trees * capacity, -1, np.int32)
+    base = 0
+    for t, (segs, n) in enumerate(per_tree):
+        k = segs["sg_len"].shape[0]
+        if base + k > s_max:
+            raise OverflowError(
+                f"segment budget {s_max} < {base + k} segments"
+            )
+        sl = slice(base, base + k)
+        out["sg_min_hi"][sl] = segs["sg_min_hi"]
+        out["sg_min_lo"][sl] = segs["sg_min_lo"]
+        out["sg_max_hi"][sl] = segs["sg_max_hi"]
+        out["sg_max_lo"][sl] = segs["sg_max_lo"]
+        out["sg_len"][sl] = segs["sg_len"]
+        out["sg_lane0"][sl] = segs["sg_head_lane"] + t * capacity
+        out["sg_dense"][sl] = segs["sg_dense"]
+        out["sg_tail_special"][sl] = segs["sg_tail_special"]
+        out["sg_valid"][sl] = True
+        rl = segs["run_of_lane"]
+        lane_sl = slice(t * capacity, t * capacity + n)
+        seg[lane_sl] = rl[:n] + base
+        base += k
+    out["seg"] = seg
+    return out

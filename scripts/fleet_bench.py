@@ -30,7 +30,7 @@ import jax.numpy as jnp
 
 def kernel_level(K: int, n_base: int, n_div: int, cap: int) -> dict:
     from cause_tpu import benchgen
-    from cause_tpu.weaver.jaxw3 import merge_weave_kernel_v3_jit
+    from cause_tpu.weaver.jaxw4 import merge_weave_kernel_v4_jit
 
     lanes = benchgen.fleet_lanes(
         n_replicas=K, n_base=n_base, n_div=n_div, capacity=cap,
@@ -44,10 +44,10 @@ def kernel_level(K: int, n_base: int, n_div: int, cap: int) -> dict:
     )
     k_max = max(1024, 1024 + (est * K) // 2)
     args = [jax.device_put(jnp.asarray(lanes[k]))
-            for k in benchgen.LANE_KEYS]
+            for k in benchgen.LANE_KEYS4]
 
     def step(k):
-        o, r, v, c, ovf = merge_weave_kernel_v3_jit(*args, k_max=k)
+        o, r, v, c, ovf = merge_weave_kernel_v4_jit(*args, k_max=k)
         out = np.asarray(
             jnp.stack([jnp.sum(r.astype(jnp.float32)),
                        ovf.astype(jnp.float32)])

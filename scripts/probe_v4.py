@@ -179,6 +179,36 @@ def main():
 
     timed("ONE searchsorted K into N", ss, cum, targets)
 
+    # compaction variants: extract the flagged lanes' indices into K
+    # dense slots (ascending). searchsorted is what v3/v4 ship; top_k
+    # and sort-prefix are the candidate replacements.
+    flag = (dev["vc"] > 0) | ((dev["cci"] % 11) == 0)
+
+    @jax.jit
+    def compact_topk(f):
+        def row(fr):
+            n = fr.shape[0]
+            key = jnp.where(fr, -jnp.arange(n, dtype=jnp.int32),
+                            jnp.int32(-(1 << 30)))
+            top, _ = lax.top_k(key, K)
+            return -top
+
+        return jnp.sum(jax.vmap(row)(f).astype(jnp.float32))
+
+    timed("compaction via top_k", compact_topk, flag)
+
+    @jax.jit
+    def compact_sort(f):
+        def row(fr):
+            n = fr.shape[0]
+            key = jnp.where(fr, jnp.arange(n, dtype=jnp.int32),
+                            jnp.int32(1 << 30))
+            return lax.sort(key)[:K]
+
+        return jnp.sum(jax.vmap(row)(f).astype(jnp.float32))
+
+    timed("compaction via full sort prefix", compact_sort, flag)
+
     qidx = jnp.broadcast_to(
         (jnp.arange(K, dtype=jnp.int32) * 7) % N, (B, K)).copy()
 

@@ -1,0 +1,249 @@
+"""Parity suite for the v5 segment-union kernel: v1 remains the device
+reference (itself fuzz-verified against the pure oracle). v5 reports
+rank/visibility in CONCAT lane coordinates, so v1's sorted-lane outputs
+are mapped through its own order permutation before comparing."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import cause_tpu as c
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS, LANE_KEYS4, LANE_KEYS5
+from cause_tpu.ids import new_site_id
+from cause_tpu.weaver import jaxw, jaxw5
+from cause_tpu.weaver.arrays import NodeArrays, SiteInterner
+
+from test_list import rand_node
+
+
+def v1_concat(args_v1):
+    """v1 outputs mapped to concat-lane coordinates."""
+    o1, r1, v1, c1 = jaxw.merge_weave_kernel(*args_v1)
+    o1, r1, v1 = np.asarray(o1), np.asarray(r1), np.asarray(v1)
+    N = o1.shape[0]
+    rank_c = np.full(N, N, np.int32)
+    vis_c = np.zeros(N, bool)
+    rank_c[o1] = r1
+    vis_c[o1] = v1
+    return rank_c, vis_c, bool(c1)
+
+
+def run_v5(v5row, u_max=None, k_max=None):
+    N = v5row["hi"].shape[0]
+    if u_max is None:
+        u_max = max(8, benchgen.estimate_tokens(v5row) + 8)
+    if k_max is None:
+        k_max = u_max
+    args = tuple(jnp.asarray(v5row[k]) for k in LANE_KEYS5)
+    rank, vis, conf, ovf = jaxw5.merge_weave_kernel_v5(
+        *args, u_max=u_max, k_max=k_max
+    )
+    assert not bool(ovf), "unexpected overflow"
+    return np.asarray(rank), np.asarray(vis), bool(conf)
+
+
+def check_row(row, capacity):
+    """row: concatenated LANE_KEYS(+cci) dict; compare v5 vs v1."""
+    v5row = benchgen.v5_inputs(row, capacity)
+    a1 = tuple(jnp.asarray(row[k]) for k in LANE_KEYS)
+    rank_c, vis_c, c1 = v1_concat(a1)
+    # v1 ranks duplicate lanes at N while v5 may keep the OTHER copy
+    # of a twin (v1 keeps the first *sorted* duplicate, v5 the first
+    # copy of the twin group — same id, same body, same weave). The
+    # weave itself must agree: compare the (rank -> lane id) maps over
+    # kept lanes and the visible id multisets.
+    r5, v5_, c5 = run_v5(v5row)
+    N = rank_c.shape[0]
+
+    def weave_ids(rank, hi, lo):
+        kept = rank < N
+        out = sorted(zip(rank[kept], hi[kept], lo[kept]))
+        return [(h, l) for _, h, l in out]
+
+    assert weave_ids(rank_c, row["hi"], row["lo"]) == weave_ids(
+        r5, row["hi"], row["lo"]
+    )
+
+    def vis_ids(vis, hi, lo, rank):
+        return sorted((int(r), int(h), int(l))
+                      for r, h, l, v in zip(rank, hi, lo, vis) if v)
+
+    assert vis_ids(vis_c, row["hi"], row["lo"], rank_c) == vis_ids(
+        v5_, row["hi"], row["lo"], r5
+    )
+    return c1, c5
+
+
+@pytest.mark.parametrize(
+    "nb,nd,cap,he",
+    [(40, 12, 64, 3), (100, 40, 256, 5), (5, 3, 16, 2), (0, 4, 16, 0),
+     (31, 1, 64, 1), (200, 1, 256, 0)],
+)
+def test_v5_pair_merge_parity(nb, nd, cap, he):
+    row = benchgen.divergent_pair_lanes(
+        n_base=nb, n_div=nd, capacity=cap, hide_every=he
+    )
+    check_row(row, cap)
+
+
+def test_v5_wholesale_dedupe_actually_happens():
+    """The point of v5: the shared base must ride as one token, not
+    explode — token estimate for a large-base pair stays divergence-
+    sized."""
+    row = benchgen.divergent_pair_lanes(
+        n_base=4000, n_div=32, capacity=4096, hide_every=4
+    )
+    v5row = benchgen.v5_inputs(row, 4096)
+    n_tok = benchgen.estimate_tokens(v5row)
+    assert n_tok < 4000, n_tok  # divergence-sized, not base-sized
+    check_row(row, 4096)
+
+
+def tree_row(cl, cap=None):
+    """Single-tree concat row (one tree) from an API-built list."""
+    na = NodeArrays.from_nodes_map(cl.ct.nodes, capacity=cap)
+    hi, lo = na.id_lanes()
+    chi, clo = na.cause_lanes()
+    return {
+        "hi": hi, "lo": lo, "chi": chi, "clo": clo,
+        "cci": na.cause_idx, "vc": na.vclass, "valid": na.valid,
+    }, na.capacity
+
+
+def test_v5_fuzz_tree_parity():
+    rng = random.Random(0x5E6)
+    for _ in range(30):
+        cl = c.clist(*"ab")
+        sites = [new_site_id() for _ in range(3)]
+        for _ in range(rng.randrange(3, 25)):
+            cl = cl.insert(rand_node(rng, cl, site_id=rng.choice(sites)))
+        row, cap = tree_row(cl)
+        check_row(row, cap)
+
+
+def test_v5_concat_of_two_api_trees():
+    rng = random.Random(77)
+    base = c.clist(*"abcdef")
+    ra, rb = base, base
+    sa, sb = new_site_id(), new_site_id()
+    for _ in range(12):
+        ra = ra.insert(rand_node(rng, ra, site_id=sa))
+        rb = rb.insert(rand_node(rng, rb, site_id=sb))
+    cap = 64
+    sites = {i[1] for i in ra.ct.nodes} | {i[1] for i in rb.ct.nodes}
+    it = SiteInterner(sites)
+    naa = NodeArrays.from_nodes_map(ra.ct.nodes, capacity=cap, interner=it)
+    nab = NodeArrays.from_nodes_map(rb.ct.nodes, capacity=cap, interner=it)
+
+    def cat(xa, xb):
+        return np.concatenate([xa, xb])
+
+    hia, loa = naa.id_lanes()
+    hib, lob = nab.id_lanes()
+    chia, cloa = naa.cause_lanes()
+    chib, clob = nab.cause_lanes()
+    ccib = np.where(nab.cause_idx >= 0, nab.cause_idx + cap, -1).astype(
+        np.int32
+    )
+    row = {
+        "hi": cat(hia, hib), "lo": cat(loa, lob),
+        "chi": cat(chia, chib), "clo": cat(cloa, clob),
+        "cci": cat(naa.cause_idx, ccib),
+        "vc": cat(naa.vclass, nab.vclass),
+        "valid": cat(naa.valid, nab.valid),
+    }
+    check_row(row, cap)
+
+
+def test_v5_hypothesis_random_interactions():
+    from hypothesis import given, settings, strategies as st
+
+    @settings(max_examples=25, deadline=None)
+    @given(
+        st.lists(
+            st.tuples(st.integers(0, 2), st.integers(0, 6),
+                      st.integers(0, 2)),
+            min_size=1, max_size=18,
+        )
+    )
+    def prop(ops):
+        cl = c.clist("s")
+        sites = ["hypSiteA_____", "hypSiteB_____", "hypSiteC_____"]
+        for kind, target, site_i in ops:
+            site = sites[site_i]
+            nodes = sorted(cl.ct.nodes)
+            cause = nodes[target % len(nodes)]
+            ts = cl.get_ts() + 1
+            if kind == 0:
+                value = "v"
+            elif kind == 1:
+                value = c.hide
+            else:
+                value = c.h_show
+            cl = cl.insert(((ts, site, 0), cause, value))
+        row, cap = tree_row(cl)
+        check_row(row, cap)
+
+    prop()
+
+
+def test_v5_batched_parity_and_overflow():
+    B, cap = 5, 64
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=30, n_div=9, capacity=cap, hide_every=2
+    )
+    rows = [{k: batch[k][i] for k in LANE_KEYS4 + ("chi", "clo")}
+            for i in range(B)]
+    v5rows = [benchgen.v5_inputs(r, cap) for r in rows]
+    s_max = max(v["sg_len"].shape[0] for v in v5rows)
+    v5rows = [benchgen.v5_inputs(r, cap, s_max=s_max) for r in rows]
+    u_max = max(benchgen.estimate_tokens(v) for v in v5rows) + 8
+    stacked = {
+        k: np.stack([v[k] for v in v5rows]) for k in LANE_KEYS5
+    }
+    args = tuple(jnp.asarray(stacked[k]) for k in LANE_KEYS5)
+    rank, vis, conf, ovf = jaxw5.batched_merge_weave_v5(
+        *args, u_max=u_max, k_max=u_max
+    )
+    assert not np.asarray(ovf).any()
+    for i in range(B):
+        a1 = tuple(jnp.asarray(rows[i][k]) for k in LANE_KEYS)
+        rank_c, vis_c, _ = v1_concat(a1)
+        N = rank_c.shape[0]
+
+        def widx(rank, vism):
+            kept = rank < N
+            return (sorted(zip(rank[kept], rows[i]["hi"][kept],
+                               rows[i]["lo"][kept])),
+                    sorted(zip(rank[vism], rows[i]["hi"][vism])))
+
+        assert widx(rank_c, vis_c) == widx(
+            np.asarray(rank[i]), np.asarray(vis[i])
+        )
+    # busted token budget flags, never corrupts silently
+    *_, ovf = jaxw5.batched_merge_weave_v5(*args, u_max=8, k_max=8)
+    assert np.asarray(ovf).any()
+
+
+def test_v5_conflict_flag():
+    """Dup tokens with differing bodies flag a conflict (exploded
+    regions only — wholesale-deduped twins are exempt by design)."""
+    row = benchgen.divergent_pair_lanes(
+        n_base=10, n_div=4, capacity=32, hide_every=2
+    )
+    # corrupt a node in the *divergent* region of side B to collide
+    # with a side-A suffix id but differ in body: give B a node with
+    # A's suffix id and a different vclass
+    cap = 32
+    ia = 1 + 10 + 1          # a suffix-A lane
+    ib = cap + 1 + 10 + 2    # a suffix-B lane
+    row["hi"][ib] = row["hi"][ia]
+    row["lo"][ib] = row["lo"][ia]
+    row["vc"][ib] = 1 - (row["vc"][ia] & 1)
+    v5row = benchgen.v5_inputs(row, cap)
+    _, _, conf = run_v5(v5row, u_max=80, k_max=80)
+    assert conf
