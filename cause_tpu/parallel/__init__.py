@@ -7,4 +7,5 @@ from .mesh import (  # noqa: F401
     replica_digest,
     sharded_merge_weave,
     sharded_merge_weave_v4,
+    sharded_merge_weave_v5,
 )

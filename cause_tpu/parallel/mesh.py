@@ -42,6 +42,7 @@ __all__ = [
     "replica_digest",
     "sharded_merge_weave",
     "sharded_merge_weave_v4",
+    "sharded_merge_weave_v5",
 ]
 
 REPLICA_AXIS = "replicas"
@@ -75,15 +76,26 @@ def replica_digest(hi_sorted, lo_sorted, rank, visible):
     return jnp.sum(jnp.where(kept, mix, jnp.uint32(0)))
 
 
-def _fleet_stats(axis, hi, lo, order, rank, visible, conflict, overflow):
-    """The shared sharded-step epilogue: per-replica digests plus the
-    psum-reduced fleet stats every kernel variant reports."""
+def _fleet_reductions(axis, hi, lo, rank, visible, conflict, overflow):
+    """The psum-reduced fleet stats + per-replica digests every kernel
+    variant reports. ``hi``/``lo`` may arrive in any per-replica lane
+    order matching ``rank``'s coordinates — the digest mix-sum is
+    permutation-invariant."""
     n_overflow = lax.psum(jnp.sum(overflow.astype(jnp.int32)), axis)
-    hi_sorted = jnp.take_along_axis(hi, order, axis=1)
-    lo_sorted = jnp.take_along_axis(lo, order, axis=1)
-    digest = jax.vmap(replica_digest)(hi_sorted, lo_sorted, rank, visible)
+    digest = jax.vmap(replica_digest)(hi, lo, rank, visible)
     total_visible = lax.psum(jnp.sum(visible.astype(jnp.int32)), axis)
     n_conflicts = lax.psum(jnp.sum(conflict.astype(jnp.int32)), axis)
+    return digest, total_visible, n_conflicts, n_overflow
+
+
+def _fleet_stats(axis, hi, lo, order, rank, visible, conflict, overflow):
+    """Sorted-lane epilogue: resort the id lanes by ``order`` (rank is
+    per sorted lane for v1-v4) and attach the shared reductions."""
+    hi_sorted = jnp.take_along_axis(hi, order, axis=1)
+    lo_sorted = jnp.take_along_axis(lo, order, axis=1)
+    digest, total_visible, n_conflicts, n_overflow = _fleet_reductions(
+        axis, hi_sorted, lo_sorted, rank, visible, conflict, overflow
+    )
     return (order, rank, visible, digest, total_visible, n_conflicts,
             n_overflow)
 
@@ -180,3 +192,58 @@ def sharded_merge_weave_v4(mesh: Mesh, hi, lo, cci, vclass, valid,
     marshal time) instead of cause id lanes. Same outputs; the batch
     dimension must be divisible by the mesh size."""
     return _sharded_step_v4(mesh, k_max)(hi, lo, cci, vclass, valid)
+
+
+@lru_cache(maxsize=8)
+def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int):
+    """The v5 (segment-union) sharded step: node lanes + segment
+    tables in, per-replica (rank, visible, digest) + fleet stats out.
+    v5 reports in concat-lane coordinates and produces no ``order``;
+    the digest's mix-sum is permutation-invariant, so feeding the raw
+    lanes with concat-coordinate ranks yields the same digest value as
+    the sorted-lane kernels."""
+    from ..weaver.jaxw5 import merge_weave_kernel_v5
+
+    axis = mesh.axis_names[0]
+    sharded = P(axis)
+    replicated = P()
+
+    @partial(
+        _shard_map,
+        mesh=mesh,
+        in_specs=(sharded,) * 15,
+        out_specs=(sharded, sharded, sharded, replicated,
+                   replicated, replicated),
+    )
+    def step(hi, lo, cci, vc, va, seg, *sg):
+        rank, visible, conflict, overflow = jax.vmap(
+            lambda *r: merge_weave_kernel_v5(*r, u_max=u_max, k_max=k_max)
+        )(hi, lo, cci, vc, va, seg, *sg)
+        digest, total_visible, n_conflicts, n_overflow = _fleet_reductions(
+            axis, hi, lo, rank, visible, conflict, overflow
+        )
+        return (rank, visible, digest, total_visible, n_conflicts,
+                n_overflow)
+
+    return jax.jit(step)
+
+
+def sharded_merge_weave_v5(mesh: Mesh, lanes: dict, u_max: int,
+                           k_max: int):
+    """Shard the v5 segment-union merge over the mesh. ``lanes`` is the
+    ``benchgen.LANE_KEYS5`` dict of [B, ...] arrays. Returns
+    ``(rank, visible, digest, total_visible, n_conflicts, n_overflow)``
+    — rank/visible per concat lane (no order array in the v5
+    contract).
+
+    CAVEAT: v5's ``n_conflicts`` undercounts relative to v1-v4 — twin
+    segments deduped wholesale skip the per-node body comparison
+    (jaxw5 module docstring), so a divergent *interior* body inside an
+    otherwise-identical dense segment goes unreported here. Fleet
+    control planes that alert on conflicts should validate bodies
+    host-side (shared.union_nodes does) or use a v1/v4 pass for
+    auditing."""
+    from ..benchgen import LANE_KEYS5
+
+    step = _sharded_step_v5(mesh, u_max, k_max)
+    return step(*(lanes[k] for k in LANE_KEYS5))

@@ -467,16 +467,37 @@ def _run_budget(capacity: int) -> int:
 
 def weave_arrays(na: NodeArrays) -> Tuple[np.ndarray, np.ndarray]:
     """Run the device linearization for one tree; returns host-side
-    ``(rank, visible)`` numpy arrays. Prefers the v4 merge kernel
-    (single-tree inputs are just an already-sorted, duplicate-free
-    merge whose causes are marshal-resolved in ``cause_idx``), falls
-    back to the chain-compressed v2 and then the uncompressed v1 when
-    the run budget overflows (the estimate is computed host-side, so a
-    branchy tree never pays for a doomed compressed dispatch)."""
+    ``(rank, visible)`` numpy arrays. Prefers the v5 segment-union
+    kernel — a single tree never explodes a segment, so device work
+    collapses to segment scale plus a few full-width scans — then the
+    v4 merge kernel (marshal-resolved causes at full width), then the
+    chain-compressed v2 and the uncompressed v1 (budget estimates are
+    host-side, so a branchy tree never pays for a doomed dispatch)."""
     from .jaxw4 import merge_weave_kernel_v4_jit
+    from .jaxw5 import merge_weave_kernel_v5_jit
+    from .segments import SEG_LANE_KEYS, concat_segments, tree_segments
 
     hi, lo = na.id_lanes()
     k_max = _run_budget(na.capacity)
+    segs = tree_segments(hi, lo, na.cause_idx, na.vclass, na.n)
+    n_segs = segs["sg_len"].shape[0]
+    if n_segs <= max(16, na.capacity // 4):
+        # capacity-derived budget (NOT n_segs-derived): one compile per
+        # capacity tier, like the v4/v2 paths — a per-count s_max would
+        # retrace the kernel every time an edit crosses a table size
+        s_max = max(16, na.capacity // 4)
+        tables = concat_segments([(segs, na.n)], na.capacity, s_max)
+        u_max = s_max + 8
+        rank, visible, _, overflow = merge_weave_kernel_v5_jit(
+            jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(na.cause_idx),
+            jnp.asarray(na.vclass), jnp.asarray(na.valid),
+            jnp.asarray(tables["seg"]),
+            *(jnp.asarray(tables[k]) for k in SEG_LANE_KEYS),
+            u_max=u_max, k_max=u_max,
+        )
+        if not bool(overflow):
+            # v5 ranks are per concat lane == this tree's lane order
+            return np.asarray(rank), np.asarray(visible)
     fits = estimate_runs(na.cause_idx, na.vclass, na.valid) <= k_max
     if fits:
         _, rank, visible, _, overflow = merge_weave_kernel_v4_jit(

@@ -16,6 +16,7 @@ from cause_tpu.parallel import (
     make_mesh,
     sharded_merge_weave,
     sharded_merge_weave_v4,
+    sharded_merge_weave_v5,
 )
 from cause_tpu.weaver.arrays import NodeArrays, SiteInterner
 
@@ -73,6 +74,34 @@ def test_sharded_merge_matches_pure():
     assert np.array_equal(np.asarray(v4), visible)
     assert np.array_equal(np.asarray(d4), np.asarray(digest))
     assert int(tv4) == int(total_visible)
+    # the v5 (segment-union) sharded kernel: same digests, totals, and
+    # weave (rank arrives in concat coordinates; the digest mix-sum is
+    # permutation-invariant so values must match the sorted-lane paths)
+    from cause_tpu import benchgen as bg
+
+    v5lanes = bg.batched_v5_inputs(
+        {k: np.asarray(lanes[k]) for k in bg.LANE_KEYS4}, cap
+    )
+    u5 = bg.v5_token_budget(v5lanes)
+    r5, v5_, d5, tv5, nc5, no5 = sharded_merge_weave_v5(
+        mesh, v5lanes, u_max=u5, k_max=u5
+    )
+    assert int(no5) == 0 and int(nc5) == 0
+    assert int(tv5) == int(total_visible)
+    assert np.array_equal(np.asarray(d5), np.asarray(digest))
+    # rank equivalence through the coordinate change
+    for bidx in range(B):
+        rc = np.full(rank.shape[1], rank.shape[1], np.int32)
+        rc[order[bidx]] = rank[bidx]
+        kept1 = rc < rank.shape[1]
+        kept5 = np.asarray(r5[bidx]) < rank.shape[1]
+        hi_b = np.asarray(lanes["hi"])[bidx]
+        lo_b = np.asarray(lanes["lo"])[bidx]
+        ids1 = sorted(zip(rc[kept1], hi_b[kept1], lo_b[kept1]))
+        ids5 = sorted(zip(np.asarray(r5[bidx])[kept5], hi_b[kept5],
+                          lo_b[kept5]))
+        assert ids1 == ids5
+
     expect_total = 0
     for bidx, (a_ct, b_ct) in enumerate(pairs):
         pure = s.merge_trees(c_list.weave, a_ct, b_ct)
