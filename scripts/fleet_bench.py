@@ -28,6 +28,24 @@ import jax
 import jax.numpy as jnp
 
 
+def _timed_with_overflow_doubling(step, budget: int):
+    """Shared harness: warm/retry until the budget fits (``step``
+    raises OverflowError), then report the 3-run median and the final
+    budget actually used."""
+    while True:
+        try:
+            step(budget)
+            break
+        except OverflowError:
+            budget *= 2
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        step(budget)
+        ts.append((time.perf_counter() - t0) * 1000)
+    return float(np.median(ts)), budget
+
+
 def kernel_level(K: int, n_base: int, n_div: int, cap: int) -> dict:
     from cause_tpu import benchgen
     from cause_tpu.weaver.jaxw4 import merge_weave_kernel_v4_jit
@@ -42,7 +60,6 @@ def kernel_level(K: int, n_base: int, n_div: int, cap: int) -> dict:
     est = benchgen.estimate_pair_runs(
         {k: lanes[k][: 2 * cap] for k in benchgen.LANE_KEYS}
     )
-    k_max = max(1024, 1024 + (est * K) // 2)
     args = [jax.device_put(jnp.asarray(lanes[k]))
             for k in benchgen.LANE_KEYS4]
 
@@ -56,26 +73,62 @@ def kernel_level(K: int, n_base: int, n_div: int, cap: int) -> dict:
             raise OverflowError(k)
         return out
 
-    while True:
-        try:
-            step(k_max)
-            break
-        except OverflowError:
-            k_max *= 2
-    ts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        step(k_max)
-        ts.append((time.perf_counter() - t0) * 1000)
-    p50 = float(np.median(ts))
-    total = K * cap
+    p50, k_max = _timed_with_overflow_doubling(
+        step, max(1024, 1024 + (est * K) // 2)
+    )
     return {
         "metric": f"fleet kernel-merge {K} replicas x "
                   f"{1 + n_base + n_div} nodes -> one tree",
         "value": round(p50, 1),
         "unit": "ms",
-        "lanes": total,
+        "lanes": K * cap,
         "k_max": k_max,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def kernel_level_v5(K: int, n_base: int, n_div: int, cap: int) -> dict:
+    """The same fleet convergence through the v5 segment-union kernel:
+    all K copies of the shared base dedupe wholesale, so token count is
+    ~K * divergence instead of K * document."""
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5_jit
+
+    lanes = benchgen.fleet_lanes(
+        n_replicas=K, n_base=n_base, n_div=n_div, capacity=cap,
+        hide_every=8,
+    )
+    t0 = time.perf_counter()
+    v5row = benchgen.v5_inputs(lanes, cap)
+    marshal_ms = (time.perf_counter() - t0) * 1000
+    tokens = benchgen.estimate_tokens(v5row)
+    args = [jax.device_put(jnp.asarray(v5row[k])) for k in LANE_KEYS5]
+
+    def step(k):
+        rank, vis, c, ovf = merge_weave_kernel_v5_jit(
+            *args, u_max=k, k_max=k
+        )
+        out = np.asarray(
+            jnp.stack([jnp.sum(rank.astype(jnp.float32)),
+                       ovf.astype(jnp.float32)])
+        )
+        if out[1]:
+            raise OverflowError(k)
+        return out
+
+    p50, u_max = _timed_with_overflow_doubling(
+        step, benchgen.v5_token_budget(v5row)
+    )
+    return {
+        "metric": f"fleet kernel-merge v5 {K} replicas x "
+                  f"{1 + n_base + n_div} nodes -> one tree",
+        "value": round(p50, 1),
+        "unit": "ms",
+        "lanes": K * cap,
+        "tokens": int(tokens),
+        "u_max": u_max,
+        "marshal_ms": round(marshal_ms, 1),
         "platform": jax.devices()[0].platform,
     }
 
@@ -94,6 +147,7 @@ def api_level(K: int, n_nodes: int) -> dict:
         r = r.extend([f"r{i}-{j}" for j in range(32)])
         fleet.append(r)
 
+    fleet[0].merge_many(fleet[1:])  # warm the jit cache for this tier
     t0 = time.perf_counter()
     merged = fleet[0].merge_many(fleet[1:])
     wall = (time.perf_counter() - t0) * 1000
@@ -116,12 +170,16 @@ def main():
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
     if args.smoke:
+        print(json.dumps(kernel_level_v5(K=8, n_base=800, n_div=100,
+                                         cap=1024)))
         print(json.dumps(kernel_level(K=8, n_base=800, n_div=100,
                                       cap=1024)))
         print(json.dumps(api_level(K=8, n_nodes=1000)))
     else:
+        print(json.dumps(kernel_level_v5(K=1024, n_base=9000, n_div=1000,
+                                         cap=10240)), flush=True)
         print(json.dumps(kernel_level(K=1024, n_base=9000, n_div=1000,
-                                      cap=10240)))
+                                      cap=10240)), flush=True)
         print(json.dumps(api_level(K=64, n_nodes=10000)))
 
 
