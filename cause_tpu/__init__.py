@@ -151,6 +151,11 @@ from .collections.shared import causal_to_edn  # noqa: E402
 # Serialization: tagged JSON round-trip + bag-of-nodes reconstitution
 # (the reference's print/reader + refresh-caches checkpoint story).
 from .serde import dumps, loads  # noqa: E402
+from .sync import (  # noqa: E402
+    sync_pair,
+    sync_stream,
+    version_vector,
+)
 
 __all__ = [
     "CausalBase",
@@ -203,6 +208,9 @@ __all__ = [
     "causal_to_edn",
     "dumps",
     "loads",
+    "sync_pair",
+    "sync_stream",
+    "version_vector",
     "is_special",
     "new_uid",
     "new_site_id",

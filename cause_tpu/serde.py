@@ -53,7 +53,14 @@ from .collections.cset import CausalSet
 from .collections.shared import CausalTree
 from .ids import Keyword, Special, is_id
 
-__all__ = ["to_data", "from_data", "dumps", "loads"]
+__all__ = [
+    "to_data",
+    "from_data",
+    "dumps",
+    "loads",
+    "encode_node_items",
+    "decode_node_items",
+]
 
 _INF = float("inf")
 
@@ -75,11 +82,27 @@ def _decode_cause(d):
     return from_data(d)
 
 
-def _encode_tree(ct: CausalTree) -> dict:
-    nodes = [
+def encode_node_items(nodes_map: dict) -> list:
+    """The on-wire node-triple encoding ``[id, cause, value]`` shared
+    by tree checkpoints and sync frames — one definition so the two
+    can never drift apart."""
+    return [
         [_encode_id(nid), _encode_cause(cause), to_data(value)]
-        for nid, (cause, value) in sorted(ct.nodes.items())
+        for nid, (cause, value) in sorted(nodes_map.items())
     ]
+
+
+def decode_node_items(data: list) -> dict:
+    """Inverse of ``encode_node_items``."""
+    out = {}
+    for enc_id, enc_cause, enc_value in data:
+        nid = (enc_id[0], enc_id[1], enc_id[2])
+        out[nid] = (_decode_cause(enc_cause), from_data(enc_value))
+    return out
+
+
+def _encode_tree(ct: CausalTree) -> dict:
+    nodes = encode_node_items(ct.nodes)
     return {
         "~causal": ct.type,
         "uuid": ct.uuid,
@@ -96,10 +119,7 @@ def _decode_tree(d: dict) -> CausalTree:
     then restore the recorded clock (it may run ahead of the max node
     ts, e.g. after tombstone-only activity elsewhere in a base)."""
     kind = d["~causal"]
-    nodes = {}
-    for enc_id, enc_cause, enc_value in d["nodes"]:
-        nid = (enc_id[0], enc_id[1], enc_id[2])
-        nodes[nid] = (_decode_cause(enc_cause), from_data(enc_value))
+    nodes = decode_node_items(d["nodes"])
     if kind == s.LIST_TYPE:
         fresh, weave_fn = c_list.new_causal_tree(d["weaver"]), c_list.weave
     elif kind == s.MAP_TYPE:

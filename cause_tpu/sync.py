@@ -1,0 +1,211 @@
+"""Anti-entropy sync: converge replicas over any byte stream.
+
+The reference's distributed story is "the CRDT is the protocol" — any
+transport that moves immutable nodes between sites converges
+(reference: README.md:5), with actual p2p sync transports left as a
+roadmap wish (README.md:237-238). cause_tpu ships one: version-vector
+delta sync at the collection level.
+
+The yarn cache (per-site, time-sorted node lists — shared.cljc:64-65)
+IS a version vector: ``{site: newest ts}``. A sync round is then
+
+1. exchange version vectors (one small frame each way);
+2. send the nodes the peer hasn't seen (everything in each yarn above
+   the peer's entry — per-site suffixes, straight off the yarn cache);
+3. apply the received delta as a merge (all the append-only /
+   cause-must-exist / uuid guards come from the normal merge path, so
+   a malicious or corrupt delta is rejected exactly like a bad
+   ``insert``).
+
+Deltas assume the per-site prefix property (a replica holding a site's
+node at ts T holds all of that site's nodes below T), which this
+protocol itself preserves — anything else (e.g. a weft-truncated past)
+fails cause-must-exist and triggers the full-bag fallback frame.
+
+Frames are length-prefixed JSON (serde's tagged encoding), so the same
+session runs over sockets, pipes, files, or an in-memory loopback —
+and the payloads are exactly the "bag of nodes" the reference
+checkpoints (README.md:19).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from typing import Dict, Optional, Tuple
+
+from .collections import shared as s
+from . import serde
+
+__all__ = [
+    "version_vector",
+    "delta_nodes",
+    "shadow",
+    "apply_delta",
+    "send_frame",
+    "recv_frame",
+    "exchange_frame",
+    "sync_stream",
+    "sync_pair",
+]
+
+_HDR = struct.Struct("!I")
+MAX_FRAME = 1 << 28  # 256 MB: fail loudly on a corrupt length prefix
+
+
+def version_vector(handle) -> Dict[str, list]:
+    """{site: [ts, tx_index] of the newest node} off the yarn cache.
+    The tx index matters: ids are (ts, site, tx) and one transaction
+    mints same-ts runs, so a ts-only vector would hide a peer stuck
+    mid-run (same ts, lower tx) and silently never heal it."""
+    return {
+        site: [yarn[-1][0][0], yarn[-1][0][2]]
+        for site, yarn in handle.ct.yarns.items()
+        if yarn
+    }
+
+
+def delta_nodes(handle, peer_vv: Dict[str, list]) -> dict:
+    """The nodes the peer hasn't seen: each yarn's suffix above the
+    peer's version-vector entry (binary search per yarn — yarns are
+    time-sorted; entries compare as (ts, tx))."""
+    out = {}
+    for site, yarn in handle.ct.yarns.items():
+        h = peer_vv.get(site)
+        horizon = (int(h[0]), int(h[1])) if h else (-1, -1)
+        if not yarn or (yarn[-1][0][0], yarn[-1][0][2]) <= horizon:
+            continue
+        lo, hi = 0, len(yarn)
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if (yarn[mid][0][0], yarn[mid][0][2]) <= horizon:
+                lo = mid + 1
+            else:
+                hi = mid
+        for nid, cause, value in yarn[lo:]:
+            out[nid] = (cause, value)
+    return out
+
+
+def shadow(handle, nodes: dict):
+    """A same-type handle carrying exactly ``nodes`` — the merge-ready
+    container for a received delta. Not a valid standalone tree (causes
+    may point outside); only feed it to ``handle.merge``, which unions
+    and validates against the receiver."""
+    return type(handle)(handle.ct.evolve(nodes=dict(nodes)))
+
+
+def apply_delta(handle, nodes: dict):
+    """Merge a received delta into ``handle`` (no-op for an empty
+    delta). Raises CausalError exactly like a local merge would on
+    append-only conflicts, uuid mismatch, or missing causes."""
+    if not nodes:
+        return handle
+    return handle.merge(shadow(handle, nodes))
+
+
+def send_frame(stream, obj: dict) -> None:
+    payload = json.dumps(obj, allow_nan=False).encode()
+    stream.write(_HDR.pack(len(payload)) + payload)
+    stream.flush()
+
+
+def recv_frame(stream) -> dict:
+    hdr = stream.read(_HDR.size)
+    if len(hdr) < _HDR.size:
+        raise s.CausalError("sync stream closed mid-frame",
+                            {"causes": {"eof"}})
+    (n,) = _HDR.unpack(hdr)
+    if n > MAX_FRAME:
+        raise s.CausalError("sync frame too large",
+                            {"causes": {"frame-overflow"}, "size": n})
+    payload = stream.read(n)
+    if len(payload) < n:
+        raise s.CausalError("sync stream closed mid-frame",
+                            {"causes": {"eof"}})
+    return json.loads(payload)
+
+
+def exchange_frame(stream, obj: dict) -> dict:
+    """Send ``obj`` and receive the peer's frame CONCURRENTLY. Both
+    sync endpoints are symmetric (each sends, then expects the peer's
+    frame of the same kind); writing a large frame before reading
+    would deadlock once the two frames exceed the transport buffers,
+    so the write happens on a helper thread while this thread reads."""
+    err = []
+
+    def _send():
+        try:
+            send_frame(stream, obj)
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            err.append(e)
+
+    t = threading.Thread(target=_send, daemon=True)
+    t.start()
+    try:
+        got = recv_frame(stream)
+    finally:
+        t.join()
+    if err:
+        raise err[0]
+    return got
+
+
+def sync_stream(handle, stream):
+    """One symmetric anti-entropy round over a duplex byte stream (a
+    socket ``makefile('rwb')``, a pipe pair, ...). Both ends call this;
+    returns the converged handle.
+
+    Round: exchange hello {uuid, type, vv} (uuid and type must match)
+    / exchange deltas / merge. If either side flags that a delta was
+    inapplicable (non-prefix history, e.g. a weft), fall back to
+    exchanging the full bag of nodes. Every exchange is concurrent
+    send+recv (``exchange_frame``) so arbitrarily large frames cannot
+    deadlock the symmetric protocol.
+    """
+    ct = handle.ct
+    hello = exchange_frame(stream, {
+        "op": "hello", "uuid": ct.uuid, "type": ct.type,
+        "vv": version_vector(handle),
+    })
+    if hello.get("op") != "hello":
+        raise s.CausalError("sync protocol error",
+                            {"causes": {"bad-frame"}, "frame": hello})
+    if hello["uuid"] != ct.uuid or hello["type"] != ct.type:
+        raise s.CausalError(
+            "Causal UUID missmatch. Merge not allowed.",
+            {"causes": {"uuid-missmatch"},
+             "uuids": [ct.uuid, hello["uuid"]]},
+        )
+    delta = exchange_frame(stream, {
+        "op": "delta",
+        "nodes": serde.encode_node_items(
+            delta_nodes(handle, hello["vv"])
+        ),
+    })
+    ok = True
+    try:
+        merged = apply_delta(handle, serde.decode_node_items(delta["nodes"]))
+    except s.CausalError as e:
+        if "cause-must-exist" not in e.info.get("causes", ()):
+            raise
+        ok = False
+        merged = handle
+    # prefix-gap fallback: ask for (and offer) the full bag
+    peer_state = exchange_frame(stream, {"op": "done" if ok else "resync"})
+    if peer_state.get("op") == "resync" or not ok:
+        full = exchange_frame(stream, {
+            "op": "full", "nodes": serde.encode_node_items(dict(ct.nodes)),
+        })
+        merged = apply_delta(merged, serde.decode_node_items(full["nodes"]))
+    return merged
+
+
+def sync_pair(a, b) -> Tuple[object, object]:
+    """In-memory anti-entropy between two handles (the loopback twin of
+    ``sync_stream`` — same vv/delta path, no framing)."""
+    va, vb = version_vector(a), version_vector(b)
+    a2 = apply_delta(a, delta_nodes(b, va))
+    b2 = apply_delta(b, delta_nodes(a, vb))
+    return a2, b2

@@ -1,0 +1,192 @@
+"""Anti-entropy sync: version vectors, delta exchange, convergence over
+real sockets, and the full-bag fallback for non-prefix histories."""
+
+import socket
+import threading
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import sync
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.collections.cmap import CausalMap
+from cause_tpu.ids import new_site_id
+from cause_tpu import K
+
+
+def fork(handle, cls):
+    return cls(handle.ct.evolve(site_id=new_site_id()))
+
+
+def test_version_vector_and_delta():
+    cl = c.clist(*"abc")
+    vv = sync.version_vector(cl)
+    assert vv[cl.get_site_id()] == [cl.get_ts(), 0]
+    # a peer that knows everything gets an empty delta
+    assert sync.delta_nodes(cl, vv) == {}
+    # a peer that knows nothing gets every node (root included)
+    assert len(sync.delta_nodes(cl, {})) == len(cl.get_nodes())
+    # a peer mid-way gets exactly the suffix
+    mid = dict(vv)
+    mid[cl.get_site_id()] = [mid[cl.get_site_id()][0] - 1, 0]
+    d = sync.delta_nodes(cl, mid)
+    assert len(d) == 1
+
+
+def test_sync_pair_converges_and_is_idempotent():
+    base = c.clist(*"hello")
+    a = fork(base, CausalList).conj("!").conj("?")
+    b = fork(base, CausalList).cons("<")
+    a2, b2 = sync.sync_pair(a, b)
+    assert a2.get_nodes() == b2.get_nodes()
+    assert c.causal_to_edn(a2) == c.causal_to_edn(b2)
+    # a second round moves nothing
+    a3, b3 = sync.sync_pair(a2, b2)
+    assert a3.get_nodes() == a2.get_nodes()
+    assert sync.delta_nodes(a2, sync.version_vector(b2)) == {}
+
+
+def test_sync_pair_maps_and_sets():
+    base = c.cmap().append(K("title"), "draft")
+    a = fork(base, CausalMap).append(K("title"), "v2")
+    b = fork(base, CausalMap).append(K("author"), "bo")
+    a2, b2 = sync.sync_pair(a, b)
+    assert c.causal_to_edn(a2) == c.causal_to_edn(b2)
+    assert c.causal_to_edn(a2)[K("author")] == "bo"
+
+    from cause_tpu.collections.cset import CausalSet
+
+    sbase = c.cset("x")
+    sa = fork(sbase, CausalSet).add("y")
+    sb = fork(sbase, CausalSet).discard("x")
+    sa2, sb2 = sync.sync_pair(sa, sb)
+    assert sa2.causal_to_edn() == sb2.causal_to_edn() == {"y"}
+
+
+def test_sync_over_real_sockets():
+    base = c.clist(*"shared")
+    a = fork(base, CausalList).extend(["A1", "A2"])
+    b = fork(base, CausalList).extend(["B1"])
+
+    s1, s2 = socket.socketpair()
+    out = {}
+
+    def side(name, handle, sock):
+        with sock, sock.makefile("rwb") as stream:
+            out[name] = sync.sync_stream(handle, stream)
+
+    t1 = threading.Thread(target=side, args=("a", a, s1))
+    t2 = threading.Thread(target=side, args=("b", b, s2))
+    t1.start(); t2.start(); t1.join(5); t2.join(5)
+    assert out["a"].get_nodes() == out["b"].get_nodes()
+    assert c.causal_to_edn(out["a"]) == c.causal_to_edn(out["b"])
+    got = c.causal_to_edn(out["a"])
+    assert "A2" in got and "B1" in got
+
+
+def test_sync_uuid_mismatch_rejected():
+    a, b = c.clist("x"), c.clist("x")  # distinct uuids
+    s1, s2 = socket.socketpair()
+    errs = {}
+
+    def side(name, handle, sock):
+        with sock, sock.makefile("rwb") as stream:
+            try:
+                sync.sync_stream(handle, stream)
+            except c.CausalError as e:
+                errs[name] = e
+
+    t1 = threading.Thread(target=side, args=("a", a, s1))
+    t2 = threading.Thread(target=side, args=("b", b, s2))
+    t1.start(); t2.start(); t1.join(5); t2.join(5)
+    assert "uuid-missmatch" in errs["a"].info["causes"]
+    assert "uuid-missmatch" in errs["b"].info["causes"]
+
+
+def test_sync_fallback_on_nonprefix_history():
+    """A replica with a per-site GAP (valid tree — cross-site causes
+    make that reachable) breaks the vv-delta assumption: the peer's
+    delta references a cause inside the gap, apply fails
+    cause-must-exist, and the round falls back to the full bag — both
+    ends still converge."""
+    doc = c.clist()
+    root = c.root_id
+    x1 = ((1, "siteX________", 0), root, "x1")
+    z2 = ((2, "siteZ________", 0), root, "z2")
+    x3 = ((3, "siteX________", 0), z2[0], "x3")
+    w4 = ((4, "siteW________", 0), x1[0], "w4")
+    a = doc.insert(x1).insert(z2).insert(x3).insert(w4)
+    # b holds x3 but NOT x1: its siteX yarn is non-prefix, and a's
+    # vv-delta (which trusts vv[siteX]=3) will omit x1 while sending
+    # w4 whose cause IS x1
+    b = doc.insert(z2).insert(x3)
+    s1, s2 = socket.socketpair()
+    out = {}
+
+    def side(name, handle, sock):
+        with sock, sock.makefile("rwb") as stream:
+            out[name] = sync.sync_stream(handle, stream)
+
+    t1 = threading.Thread(target=side, args=("a", a, s1))
+    t2 = threading.Thread(target=side, args=("b", b, s2))
+    t1.start(); t2.start(); t1.join(10); t2.join(10)
+    assert out["a"].get_nodes() == out["b"].get_nodes()
+    edn = c.causal_to_edn(out["a"])
+    assert "x1" in edn and "w4" in edn  # the gap healed via full bag
+
+
+def test_same_ts_tx_run_partial_peer_heals():
+    """Ids are (ts, site, tx); one transaction mints same-ts runs. A
+    peer holding only a prefix of such a run must still receive the
+    rest — the version vector carries (ts, tx), not ts alone
+    (regression: a ts-only vv reported this sync clean and diverged
+    silently forever)."""
+    doc = c.clist()
+    site = "siteT________"
+    run = [
+        ((1, site, 0), c.root_id, "t0"),
+        ((1, site, 1), (1, site, 0), "t1"),
+        ((1, site, 2), (1, site, 1), "t2"),
+    ]
+    a = doc.insert(run[0]).insert(run[1]).insert(run[2])
+    b = doc.insert(run[0]).insert(run[1])  # stuck mid-run
+    assert sync.version_vector(b)[site] == [1, 1]
+    d = sync.delta_nodes(a, sync.version_vector(b))
+    assert (1, site, 2) in d and len(d) == 1
+    a2, b2 = sync.sync_pair(a, b)
+    assert a2.get_nodes() == b2.get_nodes()
+    assert len(b2.get_nodes()) == 4
+
+
+def test_large_deltas_do_not_deadlock_sockets():
+    """Both endpoints write their delta before reading; frames larger
+    than the socket buffers must not deadlock (regression: blocking
+    send-then-recv hung with multi-hundred-KB deltas — sends now run
+    concurrently with the read)."""
+    base = c.clist("seed")
+    a = fork(base, CausalList).extend([f"a{i}" * 4 for i in range(9000)])
+    b = fork(base, CausalList).extend([f"b{i}" * 4 for i in range(9000)])
+    s1, s2 = socket.socketpair()
+    out = {}
+
+    def side(name, handle, sock):
+        with sock, sock.makefile("rwb") as stream:
+            out[name] = sync.sync_stream(handle, stream)
+
+    t1 = threading.Thread(target=side, args=("a", a, s1), daemon=True)
+    t2 = threading.Thread(target=side, args=("b", b, s2), daemon=True)
+    t1.start(); t2.start()
+    t1.join(60); t2.join(60)
+    assert not t1.is_alive() and not t2.is_alive(), "sync deadlocked"
+    assert out["a"].get_nodes() == out["b"].get_nodes()
+    assert len(out["a"].get_nodes()) == 2 + 18000
+
+
+def test_delta_merge_validates_malicious_payload():
+    """A delta editing an existing node is rejected by the merge's
+    append-only guard, exactly like a local insert."""
+    cl = c.clist(*"ab")
+    nid = sorted(cl.get_nodes())[1]
+    evil = {nid: (cl.get_nodes()[nid][0], "EVIL")}
+    with pytest.raises(c.CausalError):
+        sync.apply_delta(cl, evil)
