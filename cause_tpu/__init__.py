@@ -152,6 +152,7 @@ from .collections.shared import causal_to_edn  # noqa: E402
 # (the reference's print/reader + refresh-caches checkpoint story).
 from .serde import dumps, loads  # noqa: E402
 from .sync import (  # noqa: E402
+    sync_base_pair,
     sync_pair,
     sync_stream,
     version_vector,
@@ -208,6 +209,7 @@ __all__ = [
     "causal_to_edn",
     "dumps",
     "loads",
+    "sync_base_pair",
     "sync_pair",
     "sync_stream",
     "version_vector",

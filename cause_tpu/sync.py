@@ -48,6 +48,7 @@ __all__ = [
     "exchange_frame",
     "sync_stream",
     "sync_pair",
+    "sync_base_pair",
 ]
 
 _HDR = struct.Struct("!I")
@@ -99,10 +100,13 @@ def shadow(handle, nodes: dict):
 def apply_delta(handle, nodes: dict):
     """Merge a received delta into ``handle`` (no-op for an empty
     delta). Raises CausalError exactly like a local merge would on
-    append-only conflicts, uuid mismatch, or missing causes."""
+    append-only conflicts, uuid mismatch, or missing causes. Uses the
+    one-pass N-way union path (one union + one reweave) rather than
+    pairwise merge, whose pure-backend form replays delta nodes one
+    insert at a time — O(delta x doc) dict copying."""
     if not nodes:
         return handle
-    return handle.merge(shadow(handle, nodes))
+    return handle.merge_many([shadow(handle, nodes)])
 
 
 def send_frame(stream, obj: dict) -> None:
@@ -169,24 +173,51 @@ def sync_stream(handle, stream):
         "op": "hello", "uuid": ct.uuid, "type": ct.type,
         "vv": version_vector(handle),
     })
-    if hello.get("op") != "hello":
-        raise s.CausalError("sync protocol error",
-                            {"causes": {"bad-frame"}, "frame": hello})
-    if hello["uuid"] != ct.uuid or hello["type"] != ct.type:
+
+    def frame_field(frame, op, key):
+        # a malformed frame is protocol corruption, not a crash: wrong
+        # op, wrong JSON shape, or missing fields all reject uniformly
+        if not isinstance(frame, dict) or frame.get("op") != op:
+            raise s.CausalError(
+                "sync protocol error",
+                {"causes": {"bad-frame"}, "expected": op},
+            )
+        try:
+            return frame[key]
+        except (KeyError, TypeError):
+            raise s.CausalError(
+                "sync protocol error",
+                {"causes": {"bad-frame"}, "expected": op,
+                 "missing": key},
+            ) from None
+
+    def decode_frame_nodes(frame, op):
+        try:
+            return serde.decode_node_items(frame_field(frame, op, "nodes"))
+        except s.CausalError:
+            raise
+        except Exception:  # noqa: BLE001 - corrupt triple shapes
+            raise s.CausalError(
+                "sync protocol error",
+                {"causes": {"bad-frame"}, "expected": op},
+            ) from None
+
+    if (frame_field(hello, "hello", "uuid") != ct.uuid
+            or frame_field(hello, "hello", "type") != ct.type):
         raise s.CausalError(
             "Causal UUID missmatch. Merge not allowed.",
             {"causes": {"uuid-missmatch"},
-             "uuids": [ct.uuid, hello["uuid"]]},
+             "uuids": [ct.uuid, hello.get("uuid")]},
         )
     delta = exchange_frame(stream, {
         "op": "delta",
         "nodes": serde.encode_node_items(
-            delta_nodes(handle, hello["vv"])
+            delta_nodes(handle, frame_field(hello, "hello", "vv"))
         ),
     })
     ok = True
     try:
-        merged = apply_delta(handle, serde.decode_node_items(delta["nodes"]))
+        merged = apply_delta(handle, decode_frame_nodes(delta, "delta"))
     except s.CausalError as e:
         if "cause-must-exist" not in e.info.get("causes", ()):
             raise
@@ -194,18 +225,82 @@ def sync_stream(handle, stream):
         merged = handle
     # prefix-gap fallback: ask for (and offer) the full bag
     peer_state = exchange_frame(stream, {"op": "done" if ok else "resync"})
+    if not isinstance(peer_state, dict):
+        raise s.CausalError("sync protocol error",
+                            {"causes": {"bad-frame"}})
     if peer_state.get("op") == "resync" or not ok:
         full = exchange_frame(stream, {
             "op": "full", "nodes": serde.encode_node_items(dict(ct.nodes)),
         })
-        merged = apply_delta(merged, serde.decode_node_items(full["nodes"]))
+        merged = apply_delta(merged, decode_frame_nodes(full, "full"))
     return merged
 
 
 def sync_pair(a, b) -> Tuple[object, object]:
     """In-memory anti-entropy between two handles (the loopback twin of
-    ``sync_stream`` — same vv/delta path, no framing)."""
+    ``sync_stream`` — same vv/delta/full-bag-fallback path, no
+    framing)."""
     va, vb = version_vector(a), version_vector(b)
-    a2 = apply_delta(a, delta_nodes(b, va))
-    b2 = apply_delta(b, delta_nodes(a, vb))
+
+    def one_way(dst, src, dst_vv):
+        try:
+            return apply_delta(dst, delta_nodes(src, dst_vv))
+        except s.CausalError as e:
+            if "cause-must-exist" not in e.info.get("causes", ()):
+                raise
+            # non-prefix history (weft, gapped replica): full bag
+            return apply_delta(dst, dict(src.ct.nodes))
+
+    return one_way(a, b, va), one_way(b, a, vb)
+
+
+def sync_base_pair(a, b) -> Tuple[object, object]:
+    """Anti-entropy between two replicas of one CausalBase: sync every
+    shared collection pairwise, copy collections the peer lacks, union
+    the history logs, and fast-forward the shared clock. Site ids and
+    undo/redo cursors stay per-replica (undo inverts only the local
+    site's transactions, base/core.cljc:354-369, so remote cursors are
+    meaningless here).
+
+    Replicas must fork AFTER the base's root collection exists: two
+    sides that each ran their first transaction independently minted
+    different root collections, which cannot converge (raised as a
+    CausalError, same stance as the uuid merge guard)."""
+    ca, cb_ = a.cb, b.cb
+    if ca.uuid != cb_.uuid:
+        raise s.CausalError(
+            "Causal UUID missmatch. Merge not allowed.",
+            {"causes": {"uuid-missmatch"}, "uuids": [ca.uuid, cb_.uuid]},
+        )
+    if (ca.root_uuid and cb_.root_uuid
+            and ca.root_uuid != cb_.root_uuid):
+        raise s.CausalError(
+            "Replicas created their root collections independently.",
+            {"causes": {"root-missmatch"},
+             "roots": [ca.root_uuid, cb_.root_uuid]},
+        )
+    root_uuid = ca.root_uuid or cb_.root_uuid
+
+    cols_a = dict(ca.collections)
+    cols_b = dict(cb_.collections)
+    for uuid in set(cols_a) | set(cols_b):
+        ha, hb = cols_a.get(uuid), cols_b.get(uuid)
+        if ha is not None and hb is not None:
+            ha2, hb2 = sync_pair(ha, hb)
+            cols_a[uuid], cols_b[uuid] = ha2, hb2
+        elif ha is None:
+            cols_a[uuid] = hb
+        else:
+            cols_b[uuid] = ha
+
+    history = sorted(
+        {(tuple(nid), uuid) for nid, uuid in ca.history}
+        | {(tuple(nid), uuid) for nid, uuid in cb_.history}
+    )
+    ts = max(ca.lamport_ts, cb_.lamport_ts)
+    base_cls = type(a)
+    a2 = base_cls(ca.evolve(collections=cols_a, history=list(history),
+                            lamport_ts=ts, root_uuid=root_uuid))
+    b2 = base_cls(cb_.evolve(collections=cols_b, history=list(history),
+                             lamport_ts=ts, root_uuid=root_uuid))
     return a2, b2

@@ -1,0 +1,114 @@
+"""Isolated-cost probe for the v5 segment-union kernel at north-star
+size: the whole kernel, the host marshal, and the isolated costs of
+its three device phase groups (segment ordering at S, token pipeline
+at U, lane expansion at N). Prints incrementally; run `python -u`.
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS5, merge_wave_scalar
+
+
+def timed(name, fn, *args, reps=2):
+    try:
+        out = np.asarray(fn(*args))
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = np.asarray(fn(*args))
+            ts.append((time.perf_counter() - t0) * 1000.0)
+        print(f"{name:48s} {float(np.median(ts)):9.1f} ms", flush=True)
+        return out
+    except Exception as e:  # noqa: BLE001 - keep probing
+        print(f"{name:48s} FAILED {type(e).__name__}: "
+              f"{str(e).splitlines()[0][:120]}", flush=True)
+        return None
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    a = ap.parse_args()
+    if a.smoke:
+        B, NB, ND, CAP = 8, 800, 100, 1024
+    else:
+        B, NB, ND, CAP = 1024, 9_000, 1_000, 10_240
+
+    print(f"platform={jax.devices()[0].platform} B={B} cap={CAP}",
+          flush=True)
+    t0 = time.perf_counter()
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=NB, n_div=ND, capacity=CAP, hide_every=8
+    )
+    t1 = time.perf_counter()
+    v5 = benchgen.batched_v5_inputs(batch, CAP)
+    t2 = time.perf_counter()
+    u = benchgen.v5_token_budget(v5)
+    print(f"lane gen {t1 - t0:.1f}s  v5 marshal {t2 - t1:.1f}s  "
+          f"u_budget={u}  S={v5['sg_len'].shape[1]}", flush=True)
+    dev = {k: jax.device_put(v5[k]) for k in LANE_KEYS5}
+    args = [dev[k] for k in LANE_KEYS5]
+    N = v5["hi"].shape[1]
+    S = v5["sg_len"].shape[1]
+
+    @jax.jit
+    def floor_prog(h):
+        return h[0, 0] + jnp.float32(0)
+
+    timed("dispatch floor", floor_prog, dev["hi"])
+
+    # phase S: segment sort + overlap groups (everything at S width)
+    @jax.jit
+    def seg_phase(mh, ml, Mh, Ml, va):
+        def row(a, b, c, d, v):
+            kh = jnp.where(v, a, 2**31 - 1)
+            kl = jnp.where(v, b, 2**31 - 1)
+            s = lax.sort((kh, kl, jnp.arange(S, dtype=jnp.int32)),
+                         num_keys=2)
+            return s[0] + c[s[2]] + d[s[2]]
+
+        return jnp.sum(jax.vmap(row)(mh, ml, Mh, Ml, va).astype(
+            jnp.float32))
+
+    timed("segment sort at S (isolated)", seg_phase, dev["sg_min_hi"],
+          dev["sg_min_lo"], dev["sg_max_hi"], dev["sg_max_lo"],
+          dev["sg_valid"])
+
+    # phase N: the expansion-side full-width work (3 cumsums +
+    # elementwise), isolated
+    @jax.jit
+    def expansion_like(h, seg):
+        def row(x, sg):
+            a = jnp.cumsum(x & 7)
+            b = jnp.cumsum((x >> 3) & 7)
+            cvr = jnp.cumsum(jnp.where(sg >= 0, 1, -1))
+            nxt = jnp.concatenate([sg[1:] == sg[:-1],
+                                   jnp.zeros((1,), bool)])
+            return (a + b + cvr + nxt.astype(jnp.int32))
+
+        return jnp.sum(jax.vmap(row)(h, seg).astype(jnp.float32))
+
+    timed("expansion-like 3 cumsums + elementwise at N",
+          expansion_like, dev["hi"], dev["seg"])
+
+    # whole kernel
+    def whole():
+        return merge_wave_scalar(*args, k_max=u, kernel="v5", u_max=u)
+
+    timed("WHOLE v5", whole)
+
+
+if __name__ == "__main__":
+    main()

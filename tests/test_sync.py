@@ -135,6 +135,49 @@ def test_sync_fallback_on_nonprefix_history():
     assert "x1" in edn and "w4" in edn  # the gap healed via full bag
 
 
+def test_sync_pair_nonprefix_fallback():
+    """The in-memory twin heals non-prefix gaps too (regression: it
+    raised cause-must-exist instead of falling back to the full bag)."""
+    doc = c.clist()
+    root = c.root_id
+    x1 = ((1, "siteX________", 0), root, "x1")
+    z2 = ((2, "siteZ________", 0), root, "z2")
+    x3 = ((3, "siteX________", 0), z2[0], "x3")
+    w4 = ((4, "siteW________", 0), x1[0], "w4")
+    a = doc.insert(x1).insert(z2).insert(x3).insert(w4)
+    b = doc.insert(z2).insert(x3)
+    a2, b2 = sync.sync_pair(a, b)
+    assert a2.get_nodes() == b2.get_nodes()
+    assert len(b2.get_nodes()) == 5
+
+
+def test_malformed_frames_raise_causal_errors():
+    """Frame-shape corruption rejects as CausalError, not KeyError."""
+    base = c.clist("x")
+    s1, s2 = socket.socketpair()
+    errs = {}
+
+    def good(sock):
+        with sock, sock.makefile("rwb") as stream:
+            try:
+                sync.sync_stream(base, stream)
+            except c.CausalError as e:
+                errs["good"] = e
+
+    def evil(sock):
+        with sock, sock.makefile("rwb") as stream:
+            sync.send_frame(stream, {"op": "hello"})  # no uuid/type/vv
+            try:
+                sync.recv_frame(stream)
+            except c.CausalError:
+                pass
+
+    t1 = threading.Thread(target=good, args=(s1,), daemon=True)
+    t2 = threading.Thread(target=evil, args=(s2,), daemon=True)
+    t1.start(); t2.start(); t1.join(5); t2.join(5)
+    assert "bad-frame" in errs["good"].info["causes"]
+
+
 def test_same_ts_tx_run_partial_peer_heals():
     """Ids are (ts, site, tx); one transaction mints same-ts runs. A
     peer holding only a prefix of such a run must still receive the
@@ -163,7 +206,7 @@ def test_large_deltas_do_not_deadlock_sockets():
     than the socket buffers must not deadlock (regression: blocking
     send-then-recv hung with multi-hundred-KB deltas — sends now run
     concurrently with the read)."""
-    base = c.clist("seed")
+    base = c.clist("seed", weaver="native")
     a = fork(base, CausalList).extend([f"a{i}" * 4 for i in range(9000)])
     b = fork(base, CausalList).extend([f"b{i}" * 4 for i in range(9000)])
     s1, s2 = socket.socketpair()
@@ -180,6 +223,55 @@ def test_large_deltas_do_not_deadlock_sockets():
     assert not t1.is_alive() and not t2.is_alive(), "sync deadlocked"
     assert out["a"].get_nodes() == out["b"].get_nodes()
     assert len(out["a"].get_nodes()) == 2 + 18000
+
+
+def test_sync_base_pair_converges_and_undo_still_works():
+    """Base-level anti-entropy: shared collections delta-sync, new
+    collections copy over, histories union — and a post-sync undo
+    still inverts only the local site's transaction."""
+    from cause_tpu.cbase import CausalBase
+
+    cb = c.base()
+    cb = c.transact(cb, [[None, None, {K("title"): "draft"}]])
+    a = CausalBase(cb.cb.evolve(site_id=new_site_id()))
+    b = CausalBase(cb.cb.evolve(site_id=new_site_id()))
+    a = c.transact(a, [[c.get_uuid(c.get_collection(a)), K("author"),
+                        "ada"]])
+    b = c.transact(b, [[c.get_uuid(c.get_collection(b)), K("status"),
+                        "wip"]])
+    # b also minted a whole nested collection a has never seen
+    b = c.transact(b, [[c.get_uuid(c.get_collection(b)), K("tags"),
+                        ["x", "y"]]])
+
+    a2, b2 = c.sync_base_pair(a, b)
+    ea, eb = c.causal_to_edn(a2), c.causal_to_edn(b2)
+    assert ea == eb
+    assert ea[K("author")] == "ada" and ea[K("status")] == "wip"
+    assert set(a2.cb.collections) == set(b2.cb.collections)
+    assert a2.cb.history == b2.cb.history
+    # local undo after sync: a's last LOCAL tx was "author"
+    a3 = c.undo(a2)
+    e3 = c.causal_to_edn(a3)
+    assert K("author") not in e3 and e3[K("status")] == "wip"
+    # repeated sync is stable
+    a4, b4 = c.sync_base_pair(a2, b2)
+    assert c.causal_to_edn(a4) == ea and a4.cb.history == a2.cb.history
+
+
+def test_sync_base_uuid_and_root_guards():
+    from cause_tpu.cbase import CausalBase
+
+    with pytest.raises(c.CausalError):
+        c.sync_base_pair(c.base(), c.base())  # different base uuids
+    # same base uuid, but both sides minted their root independently
+    blank = c.base()
+    a = CausalBase(blank.cb.evolve(site_id=new_site_id()))
+    b = CausalBase(blank.cb.evolve(site_id=new_site_id()))
+    a = c.transact(a, [[None, None, {K("x"): 1}]])
+    b = c.transact(b, [[None, None, {K("y"): 2}]])
+    with pytest.raises(c.CausalError) as e:
+        c.sync_base_pair(a, b)
+    assert "root-missmatch" in e.value.info["causes"]
 
 
 def test_delta_merge_validates_malicious_payload():
