@@ -140,6 +140,41 @@ def get_weave(causal):
     return causal.get_weave()
 
 
+def blame(causal):
+    """Who wrote what, when: the visible content annotated with each
+    element's author site and lamport time. Every node carries complete
+    history information — "time = lamport-ts, who = site-id"
+    (reference: README.md:48) — so blame is a projection of the weave,
+    not extra bookkeeping.
+
+    Lists (and sets/counters, which share the list tree) yield
+    ``[(value, site_id, lamport_ts), ...]`` in weave order; maps yield
+    ``{key: (value, site_id, lamport_ts)}`` for each live key (the LWW
+    winner's author); bases yield ``{keyword_path_key: ...}`` per
+    collection uuid."""
+    from .cbase import CausalBase as _CB
+    from .collections.clist import causal_list_to_list
+    from .collections.cmap import BLANK, CausalMap as _CM, active_node
+
+    if isinstance(causal, _CB):
+        return {
+            uuid: blame(coll)
+            for uuid, coll in causal.cb.collections.items()
+        }
+    if isinstance(causal, _CM):
+        out = {}
+        for key, key_weave in causal.ct.weave.items():
+            node = active_node(key, key_weave)
+            if node is not BLANK:
+                nid = node[0]
+                out[key] = (node[2], nid[1], nid[0])
+        return out
+    return [
+        (value, nid[1], nid[0])
+        for nid, _cause, value in causal_list_to_list(causal.ct)
+    ]
+
+
 def get_nodes(causal):
     """The canonical {id: (cause, value)} store (protocols.cljc:16-17)."""
     return causal.get_nodes()
@@ -204,6 +239,7 @@ __all__ = [
     "weft",
     "merge",
     "merge_all",
+    "blame",
     "get_weave",
     "get_nodes",
     "causal_to_edn",

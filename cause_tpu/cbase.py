@@ -504,15 +504,20 @@ def reset_(cb: CB, tx_id, site_ids=None):
 
 def get_next_tx_id(cb: CB, last_undo_or_redo_ts):
     """The tx-id next in line to be undone/redone: the newest local-site
-    entry at or before the cursor (base/core.cljc:354-369)."""
-    if last_undo_or_redo_ts is not None:
-        remaining = subhis(
-            cb, None, (last_undo_or_redo_ts - 1, cb.site_id)
-        )
-    else:
-        remaining = cb.history
-    for rp in reversed(list(remaining)):
+    entry strictly below the cursor (base/core.cljc:354-369).
+
+    The reference slices history to the exact tx (cursor-1, site) —
+    sound there because a base's clock only ever advances through local
+    transactions, so local tx timestamps are consecutive. Here
+    ``sync_base_pair`` fast-forwards the clock past timestamps consumed
+    by peers, so the previous local tx can sit at ANY lower ts; scan
+    for it instead of assuming cursor-1 (an exact-slice miss silently
+    ended the undo chain after one post-sync undo)."""
+    limit = last_undo_or_redo_ts
+    for rp in reversed(cb.history):
         lamport_ts, site_id = rp[0][0], rp[0][1]
+        if limit is not None and lamport_ts >= limit:
+            continue
         if site_id == cb.site_id:
             return (lamport_ts, cb.site_id)
     return None

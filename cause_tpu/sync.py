@@ -100,13 +100,22 @@ def shadow(handle, nodes: dict):
 def apply_delta(handle, nodes: dict):
     """Merge a received delta into ``handle`` (no-op for an empty
     delta). Raises CausalError exactly like a local merge would on
-    append-only conflicts, uuid mismatch, or missing causes. Uses the
-    one-pass N-way union path (one union + one reweave) rather than
-    pairwise merge, whose pure-backend form replays delta nodes one
-    insert at a time — O(delta x doc) dict copying."""
+    append-only conflicts, uuid mismatch, or missing causes.
+
+    Path choice matters on the default pure weaver: ``merge`` replays
+    the delta incrementally (O(delta x doc) — right for anti-entropy's
+    steady state of small deltas into large docs), while ``merge_many``
+    does one union + one full reweave (O(doc^2) pure, but the fast
+    path under the native/jax backends and for bulk deltas). Small
+    deltas on the pure backend take the incremental path; everything
+    else takes the one-pass union."""
     if not nodes:
         return handle
-    return handle.merge_many([shadow(handle, nodes)])
+    sh = shadow(handle, nodes)
+    if (handle.ct.weaver == "pure"
+            and len(nodes) * 8 < len(handle.ct.nodes)):
+        return handle.merge(sh)
+    return handle.merge_many([sh])
 
 
 def send_frame(stream, obj: dict) -> None:
@@ -225,9 +234,12 @@ def sync_stream(handle, stream):
         merged = handle
     # prefix-gap fallback: ask for (and offer) the full bag
     peer_state = exchange_frame(stream, {"op": "done" if ok else "resync"})
-    if not isinstance(peer_state, dict):
-        raise s.CausalError("sync protocol error",
-                            {"causes": {"bad-frame"}})
+    if (not isinstance(peer_state, dict)
+            or peer_state.get("op") not in ("done", "resync")):
+        raise s.CausalError(
+            "sync protocol error",
+            {"causes": {"bad-frame"}, "expected": "done|resync"},
+        )
     if peer_state.get("op") == "resync" or not ok:
         full = exchange_frame(stream, {
             "op": "full", "nodes": serde.encode_node_items(dict(ct.nodes)),

@@ -38,3 +38,38 @@ def test_meta_accessors():
     assert isinstance(c.get_uuid(cl), str) and len(c.get_uuid(cl)) == 21
     assert isinstance(c.get_site_id(cl), str) and len(c.get_site_id(cl)) == 13
     assert c.get_ts(cl) == 1
+
+
+def test_blame_projects_authorship():
+    """blame = who wrote what, when — a projection of node metadata
+    (reference: README.md:48 'time = lamport-ts, who = site-id')."""
+    import cause_tpu as c
+    from cause_tpu import K
+    from cause_tpu.collections.clist import CausalList
+    from cause_tpu.ids import new_site_id
+
+    base = c.clist(*"ab")
+    other = CausalList(base.ct.evolve(site_id=new_site_id()))
+    other = other.conj("X")
+    merged = c.merge(base, other)
+    bl = c.blame(merged)
+    assert [v for v, _, _ in bl] == c.causal_to_edn(merged)
+    assert {site for _, site, _ in bl} == {base.get_site_id(),
+                                           other.get_site_id()}
+    by_val = {v: site for v, site, _ in bl}
+    assert by_val["X"] == other.get_site_id()
+    assert by_val["a"] == base.get_site_id()
+
+    cm = c.cmap().append(K("t"), "v1")
+    cm2 = c.CausalMap(cm.ct.evolve(site_id=new_site_id()))
+    cm2 = cm2.append(K("t"), "v2")
+    m = c.merge(cm, cm2)
+    bm = c.blame(m)
+    val, site, ts = bm[K("t")]
+    assert val == "v2" and site == cm2.get_site_id()
+
+    cb = c.base()
+    cb = c.transact(cb, [[None, None, {K("k"): 1}]])
+    bb = c.blame(cb)
+    root_blame = bb[c.get_uuid(c.get_collection(cb))]
+    assert root_blame[K("k")][0] == 1

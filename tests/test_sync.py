@@ -282,3 +282,33 @@ def test_delta_merge_validates_malicious_payload():
     evil = {nid: (cl.get_nodes()[nid][0], "EVIL")}
     with pytest.raises(c.CausalError):
         sync.apply_delta(cl, evil)
+
+
+def test_undo_chain_survives_clock_fast_forward():
+    """After sync fast-forwards the clock past peer-consumed
+    timestamps, EVERY local transaction must stay undoable (regression:
+    the exact cursor-1 history slice silently ended the chain after
+    one post-sync undo)."""
+    from cause_tpu.cbase import CausalBase
+
+    cb = c.base()
+    cb = c.transact(cb, [[None, None, {K("seed"): 0}]])
+    a = CausalBase(cb.cb.evolve(site_id=new_site_id()))
+    b = CausalBase(cb.cb.evolve(site_id=new_site_id()))
+    a = c.transact(a, [[c.get_uuid(c.get_collection(a)), K("a1"), 1]])
+    # the peer burns several timestamps
+    for i in range(4):
+        b = c.transact(b, [[c.get_uuid(c.get_collection(b)),
+                            K(f"b{i}"), i]])
+    a2, _ = c.sync_base_pair(a, b)
+    a2 = c.transact(a2, [[c.get_uuid(c.get_collection(a2)),
+                          K("a2"), 2]])
+    u1 = c.undo(a2)
+    assert K("a2") not in c.causal_to_edn(u1)
+    u2 = c.undo(u1)
+    e2 = c.causal_to_edn(u2)
+    assert K("a1") not in e2, "second post-sync undo must still work"
+    assert e2[K("b3")] == 3  # peer content untouched
+    # and redo walks back up across the same gap
+    r1 = c.redo(u2)
+    assert K("a1") in c.causal_to_edn(r1)
