@@ -114,6 +114,34 @@ def tree_row(cl, cap=None):
     }, na.capacity
 
 
+def concat_api_rows(handles, cap):
+    """Concat-row dict for K API-built replicas: shared interner,
+    per-tree NodeArrays at ``cap`` lanes, cci block-offsets."""
+    sites = set()
+    for h in handles:
+        sites |= {i[1] for i in h.ct.nodes}
+    it = SiteInterner(sites)
+    nas = [NodeArrays.from_nodes_map(h.ct.nodes, capacity=cap, interner=it)
+           for h in handles]
+
+    def cat(pick):
+        return np.concatenate([pick(na) for na in nas])
+
+    return {
+        "hi": cat(lambda na: na.id_lanes()[0]),
+        "lo": cat(lambda na: na.id_lanes()[1]),
+        "chi": cat(lambda na: na.cause_lanes()[0]),
+        "clo": cat(lambda na: na.cause_lanes()[1]),
+        "cci": np.concatenate([
+            np.where(na.cause_idx >= 0, na.cause_idx + i * cap, -1).astype(
+                np.int32)
+            for i, na in enumerate(nas)
+        ]),
+        "vc": cat(lambda na: na.vclass),
+        "valid": cat(lambda na: na.valid),
+    }
+
+
 def test_v5_fuzz_tree_parity():
     rng = random.Random(0x5E6)
     for _ in range(30):
@@ -133,30 +161,7 @@ def test_v5_concat_of_two_api_trees():
     for _ in range(12):
         ra = ra.insert(rand_node(rng, ra, site_id=sa))
         rb = rb.insert(rand_node(rng, rb, site_id=sb))
-    cap = 64
-    sites = {i[1] for i in ra.ct.nodes} | {i[1] for i in rb.ct.nodes}
-    it = SiteInterner(sites)
-    naa = NodeArrays.from_nodes_map(ra.ct.nodes, capacity=cap, interner=it)
-    nab = NodeArrays.from_nodes_map(rb.ct.nodes, capacity=cap, interner=it)
-
-    def cat(xa, xb):
-        return np.concatenate([xa, xb])
-
-    hia, loa = naa.id_lanes()
-    hib, lob = nab.id_lanes()
-    chia, cloa = naa.cause_lanes()
-    chib, clob = nab.cause_lanes()
-    ccib = np.where(nab.cause_idx >= 0, nab.cause_idx + cap, -1).astype(
-        np.int32
-    )
-    row = {
-        "hi": cat(hia, hib), "lo": cat(loa, lob),
-        "chi": cat(chia, chib), "clo": cat(cloa, clob),
-        "cci": cat(naa.cause_idx, ccib),
-        "vc": cat(naa.vclass, nab.vclass),
-        "valid": cat(naa.valid, nab.valid),
-    }
-    check_row(row, cap)
+    check_row(concat_api_rows([ra, rb], 64), 64)
 
 
 def test_v5_hypothesis_random_interactions():
@@ -247,3 +252,20 @@ def test_v5_conflict_flag():
     v5row = benchgen.v5_inputs(row, cap)
     _, _, conf = run_v5(v5row, u_max=80, k_max=80)
     assert conf
+
+
+def test_v5_three_way_union_parity():
+    """K-ary union: three replicas' lanes concatenated — twin groups of
+    three (the shared base), multi-interval overlaps, and cross-replica
+    causes must all resolve exactly as v1."""
+    from cause_tpu.collections.clist import CausalList
+
+    rng = random.Random(31337)
+    base = c.clist(*"abcde")
+    reps = []
+    for _ in range(3):
+        r = CausalList(base.ct.evolve(site_id=new_site_id()))
+        for _ in range(8):
+            r = r.insert(rand_node(rng, r, site_id=r.ct.site_id))
+        reps.append(r)
+    check_row(concat_api_rows(reps, 32), 32)

@@ -312,3 +312,36 @@ def test_undo_chain_survives_clock_fast_forward():
     # and redo walks back up across the same gap
     r1 = c.redo(u2)
     assert K("a1") in c.causal_to_edn(r1)
+
+
+def test_random_sync_network_converges():
+    """Property: random edits on N replicas + random pairwise sync
+    rounds until quiescent == the N-way merge of all replicas (the
+    weave is a pure function of the node set, so gossip order cannot
+    matter)."""
+    import random as _random
+
+    rng = _random.Random(2026)
+    base = c.clist(*"doc")
+    n = 4
+    reps = [fork(base, CausalList) for _ in range(n)]
+    for step in range(30):
+        i = rng.randrange(n)
+        r = reps[i]
+        kind = rng.random()
+        if kind < 0.6:
+            reps[i] = r.conj(f"v{step}")
+        elif kind < 0.8 and len(r.get_weave()) > 1:
+            nid = rng.choice([nd[0] for nd in r.get_weave()[1:]])
+            reps[i] = r.append(nid, c.hide)
+        else:
+            a, b = rng.sample(range(n), 2)
+            reps[a], reps[b] = sync.sync_pair(reps[a], reps[b])
+    expected = reps[0].merge_many(reps[1:])
+    # full gossip sweep: every pair once is enough after merge closure
+    for a in range(n):
+        for b in range(a + 1, n):
+            reps[a], reps[b] = sync.sync_pair(reps[a], reps[b])
+    for r in reps:
+        assert r.get_nodes() == expected.get_nodes()
+        assert c.causal_to_edn(r) == c.causal_to_edn(expected)
