@@ -160,7 +160,6 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     )
     grp_start = ~same_prev
     grp = jnp.cumsum(grp_start.astype(jnp.int32)) - 1
-    n_grp = grp[-1] + 1
 
     # per-group interval tables (twins share min/max by construction)
     gsl = jnp.where(grp_start & s_va, grp, S - 1)
@@ -445,8 +444,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     lane_key = jnp.where(keep_t & (rank_tok < N), sv_lane, N)
     lk, tok_at = lax.sort((lane_key, uidx), num_keys=1)
     tb_l = rank_tok[tok_at]
-    tl_l = jnp.where(lane_key[tok_at] < N, lane_key[tok_at], 0)
-    ok_l = lane_key[tok_at] < N
+    tl_l = jnp.where(lk < N, lk, 0)
+    ok_l = lk < N
     d_base = jnp.where(
         ok_l,
         tb_l - jnp.concatenate([jnp.zeros((1,), jnp.int32), tb_l[:-1]]),
