@@ -68,8 +68,17 @@ def measure(platform: str) -> dict:
 
     import jax
 
+    from cause_tpu.benchgen import enable_compile_cache
+
     if platform == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # persistent compile cache: the 1024x20k kernels cost tens of
+        # seconds of XLA compile; share it across bench/probe runs.
+        # (Consults the default backend — fine here, the TPU attempt
+        # initializes it immediately below anyway; the cpu path above
+        # must NOT call it or it would init the possibly-wedged tunnel.)
+        enable_compile_cache()
 
     from cause_tpu import benchgen
     from cause_tpu.benchgen import (

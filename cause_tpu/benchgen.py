@@ -42,6 +42,7 @@ __all__ = [
     "estimate_pair_runs",
     "pair_run_budget",
     "merge_wave_scalar",
+    "enable_compile_cache",
     "v5_inputs",
     "batched_v5_inputs",
     "v5_token_budget",
@@ -118,6 +119,34 @@ def pair_run_budget(batch: Dict[str, np.ndarray], sample_rows: int = 4) -> int:
         rows = [{k: batch[k][i] for k in LANE_KEYS} for i in picks]
     worst = max(estimate_pair_runs(r) for r in rows)
     return int(worst + max(64, worst // 8))
+
+
+def enable_compile_cache(path: str = "/tmp/jax_comp_cache") -> None:
+    """Point JAX's persistent compilation cache at a shared directory so
+    the tens-of-seconds XLA compiles of the full-size kernels are paid
+    once across bench.py, the probe scripts, and repeat invocations.
+
+    TPU-class backends only: XLA:CPU AOT reloads are pinned to the
+    compile machine's CPU features (reloading warns about SIGILL risk),
+    and CPU compiles here are seconds, not minutes. Safe no-op on jax
+    builds without the knob. NOTE: consults the default backend, so
+    call it where backend initialization is already acceptable."""
+    import os as _os
+
+    import jax as _jax
+
+    try:
+        if _jax.default_backend() == "cpu":
+            return
+        _jax.config.update(
+            "jax_compilation_cache_dir",
+            _os.environ.get("JAX_COMPILATION_CACHE_DIR", path),
+        )
+        _jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 5.0
+        )
+    except Exception:  # pragma: no cover - older jax
+        pass
 
 
 _scalar_programs: Dict = {}

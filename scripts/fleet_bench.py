@@ -161,6 +161,8 @@ def api_level(K: int, n_nodes: int) -> dict:
 
 
 def main():
+    from cause_tpu.benchgen import enable_compile_cache
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--cpu", action="store_true",
@@ -169,6 +171,10 @@ def main():
     args = ap.parse_args()
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # after the platform decision: consults the default backend,
+        # which must not happen before a --cpu override lands
+        enable_compile_cache()
     if args.smoke:
         print(json.dumps(kernel_level_v5(K=8, n_base=800, n_div=100,
                                          cap=1024)))

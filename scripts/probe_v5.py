@@ -38,6 +38,9 @@ def timed(name, fn, *args, reps=2):
 
 
 def main():
+    from cause_tpu.benchgen import enable_compile_cache
+
+    enable_compile_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     a = ap.parse_args()

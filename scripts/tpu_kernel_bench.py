@@ -23,6 +23,9 @@ from cause_tpu.benchmarks import config5_batched_merge
 
 
 def main():
+    from cause_tpu.benchgen import enable_compile_cache
+
+    enable_compile_cache()
     B = int(sys.argv[1]) if len(sys.argv) > 1 else 64
     n_base = int(sys.argv[2]) if len(sys.argv) > 2 else 9000
     n_div = int(sys.argv[3]) if len(sys.argv) > 3 else 1000
