@@ -115,3 +115,23 @@ def test_merge_after_round_trip():
 def test_unserializable_raises():
     with pytest.raises(c.CausalError):
         serde.dumps(object())
+
+
+def test_nonfinite_floats_round_trip_strict_json():
+    """NaN/inf values are tagged so the emitted JSON stays RFC-strict
+    (a bare NaN literal breaks every non-Python parser)."""
+    import json
+    import math
+
+    import cause_tpu as c
+    from cause_tpu import serde
+
+    cl = c.clist(float("nan"), float("inf"), float("-inf"), 1.5)
+    text = serde.dumps(cl)
+    json.loads(text)  # strict parse must succeed
+    assert "NaN" not in text and "Infinity" not in text
+    back = serde.loads(text)
+    vals = c.causal_to_edn(back)
+    assert math.isnan(vals[0])
+    assert vals[1] == float("inf") and vals[2] == float("-inf")
+    assert vals[3] == 1.5
