@@ -123,13 +123,14 @@ def test_nonfinite_floats_round_trip_strict_json():
     import json
     import math
 
-    import cause_tpu as c
-    from cause_tpu import serde
-
     cl = c.clist(float("nan"), float("inf"), float("-inf"), 1.5)
     text = serde.dumps(cl)
-    json.loads(text)  # strict parse must succeed
-    assert "NaN" not in text and "Infinity" not in text
+    # Python's json accepts bare NaN/Infinity by default — reject them
+    # explicitly so the parse itself enforces RFC-strictness
+    json.loads(
+        text,
+        parse_constant=lambda s: pytest.fail(f"non-strict constant {s}"),
+    )
     back = serde.loads(text)
     vals = c.causal_to_edn(back)
     assert math.isnan(vals[0])
