@@ -269,3 +269,28 @@ def test_v5_three_way_union_parity():
             r = r.insert(rand_node(rng, r, site_id=r.ct.site_id))
         reps.append(r)
     check_row(concat_api_rows(reps, 32), 32)
+
+
+def test_v5_adversarial_replica_fuzz():
+    """Directed fuzz of the segment-union edge logic: random replica
+    counts (2-4), random shared-prefix lengths, random multi-site
+    interleavings with tombstone chains — every case must match v1
+    exactly. Targets E1 overlap shapes, twin groups of every size, and
+    cross-replica cause stabs the corpus tests don't enumerate."""
+    from cause_tpu.collections.clist import CausalList
+
+    rng = random.Random(0xD1CE)
+    for case in range(40):
+        n_rep = rng.randrange(2, 5)
+        base = c.clist(*[f"b{i}" for i in range(rng.randrange(1, 12))])
+        reps = []
+        for _ in range(n_rep):
+            r = CausalList(base.ct.evolve(site_id=new_site_id()))
+            sites = [r.ct.site_id, new_site_id()]
+            for _ in range(rng.randrange(0, 10)):
+                r = r.insert(
+                    rand_node(rng, r, site_id=rng.choice(sites))
+                )
+            reps.append(r)
+        cap = 64
+        check_row(concat_api_rows(reps, cap), cap)
