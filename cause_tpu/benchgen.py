@@ -23,7 +23,7 @@ same contract ``SiteInterner`` enforces for real trees.
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Optional
 
 import numpy as np
 
@@ -121,7 +121,23 @@ def pair_run_budget(batch: Dict[str, np.ndarray], sample_rows: int = 4) -> int:
     return int(worst + max(64, worst // 8))
 
 
-def enable_compile_cache(path: str = "/tmp/jax_comp_cache") -> None:
+def _default_cache_dir() -> str:
+    """Per-user cache location: a fixed world-shared /tmp path collides
+    across users and is pre-creatable by any local user; key it by uid
+    (and honor XDG/home when available)."""
+    import os as _os
+    import tempfile as _tempfile
+
+    home = _os.path.expanduser("~")
+    if home and home != "~" and _os.access(home, _os.W_OK):
+        return _os.path.join(home, ".cache", "cause_tpu",
+                             "jax_comp_cache")
+    uid = _os.getuid() if hasattr(_os, "getuid") else "u"
+    return _os.path.join(_tempfile.gettempdir(),
+                         f"jax_comp_cache_{uid}")
+
+
+def enable_compile_cache(path: Optional[str] = None) -> None:
     """Point JAX's persistent compilation cache at a shared directory so
     the tens-of-seconds XLA compiles of the full-size kernels are paid
     once across bench.py, the probe scripts, and repeat invocations.
@@ -140,7 +156,8 @@ def enable_compile_cache(path: str = "/tmp/jax_comp_cache") -> None:
             return
         _jax.config.update(
             "jax_compilation_cache_dir",
-            _os.environ.get("JAX_COMPILATION_CACHE_DIR", path),
+            _os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                            path or _default_cache_dir()),
         )
         _jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", 5.0

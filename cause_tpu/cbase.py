@@ -244,10 +244,13 @@ def map_to_nodes(cb: CB, tx_index: int, map_value):
 
 def list_to_nodes(cb: CB, tx_index: int, list_value, cause=None):
     """Flatten a sequence into cause-chained nodes; strings explode to
-    char nodes inline (base/core.cljc:140-156). Returns
+    char nodes inline (base/core.cljc:140-156). Divergence: the
+    reference splits per code unit (its char-seq helper is unused and
+    ZWJ-broken, util.cljc:94-97); we split into grapheme-ish clusters
+    via util.char_seq so combined emoji stay single nodes. Returns
     ``(cb, tx_index, nodes, last_node_id)``."""
     is_string = isinstance(list_value, str)
-    value = list(list_value) if is_string else _as_seq(list_value)
+    value = u.char_seq(list_value) if is_string else _as_seq(list_value)
     nodes = []
     cause = cause if cause is not None else ROOT_ID
     for v in value:

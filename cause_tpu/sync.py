@@ -53,6 +53,10 @@ __all__ = [
 
 _HDR = struct.Struct("!I")
 MAX_FRAME = 1 << 28  # 256 MB: fail loudly on a corrupt length prefix
+# how long a completed receive waits for our own send to drain before
+# declaring the peer wedged (generous: full-bag frames on slow uplinks
+# legitimately take minutes)
+SEND_DRAIN_TIMEOUT = 600.0
 
 
 def version_vector(handle) -> Dict[str, list]:
@@ -124,20 +128,27 @@ def send_frame(stream, obj: dict) -> None:
     stream.flush()
 
 
+def _read_exact(stream, n: int) -> bytes:
+    """Accumulate exactly ``n`` bytes. Raw sockets and unbuffered pipes
+    may legally return short reads; only an empty read means EOF."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = stream.read(n - got)
+        if not chunk:
+            raise s.CausalError("sync stream closed mid-frame",
+                                {"causes": {"eof"}})
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
 def recv_frame(stream) -> dict:
-    hdr = stream.read(_HDR.size)
-    if len(hdr) < _HDR.size:
-        raise s.CausalError("sync stream closed mid-frame",
-                            {"causes": {"eof"}})
-    (n,) = _HDR.unpack(hdr)
+    (n,) = _HDR.unpack(_read_exact(stream, _HDR.size))
     if n > MAX_FRAME:
         raise s.CausalError("sync frame too large",
                             {"causes": {"frame-overflow"}, "size": n})
-    payload = stream.read(n)
-    if len(payload) < n:
-        raise s.CausalError("sync stream closed mid-frame",
-                            {"causes": {"eof"}})
-    return json.loads(payload)
+    return json.loads(_read_exact(stream, n))
 
 
 def exchange_frame(stream, obj: dict) -> dict:
@@ -158,8 +169,24 @@ def exchange_frame(stream, obj: dict) -> dict:
     t.start()
     try:
         got = recv_frame(stream)
-    finally:
-        t.join()
+        # bounded even on success: a peer that answered and then
+        # stopped draining would otherwise hang this join forever. The
+        # bound is generous (SEND_DRAIN_TIMEOUT) because a slow uplink
+        # legitimately takes minutes for a full-bag frame — only a
+        # genuinely wedged peer should trip it.
+        t.join(timeout=SEND_DRAIN_TIMEOUT)
+        if t.is_alive():
+            raise s.CausalError(
+                "sync peer stopped draining mid-frame",
+                {"causes": {"send-stalled"}},
+            )
+    except BaseException:
+        # The receive failed (bad frame, uuid mismatch, EOF). The
+        # writer may be blocked on a transport buffer the peer will
+        # never drain; it's a daemon thread, so give it a short grace
+        # period and surface the receive error either way.
+        t.join(timeout=1.0)
+        raise
     if err:
         raise err[0]
     return got
@@ -218,11 +245,21 @@ def sync_stream(handle, stream):
             {"causes": {"uuid-missmatch"},
              "uuids": [ct.uuid, hello.get("uuid")]},
         )
+    peer_vv = frame_field(hello, "hello", "vv")
+    if not (isinstance(peer_vv, dict) and all(
+            isinstance(site, str)
+            and isinstance(h, (list, tuple)) and len(h) == 2
+            and all(isinstance(x, int) and not isinstance(x, bool)
+                    for x in h)
+            for site, h in peer_vv.items())):
+        raise s.CausalError(
+            "sync protocol error",
+            {"causes": {"bad-frame"}, "expected": "hello",
+             "missing": "vv"},
+        )
     delta = exchange_frame(stream, {
         "op": "delta",
-        "nodes": serde.encode_node_items(
-            delta_nodes(handle, frame_field(hello, "hello", "vv"))
-        ),
+        "nodes": serde.encode_node_items(delta_nodes(handle, peer_vv)),
     })
     ok = True
     try:

@@ -67,8 +67,10 @@ def char_seq(text: str):
     chars are whole by construction. We additionally keep combining
     marks, ZWJ sequences and variation selectors glued to their base
     character — the case the reference documents as known-broken
-    (util.cljc:94-97). Like the reference it is available but not wired
-    into the CausalBase flattener, which splits per code point.
+    (util.cljc:94-97). Unlike the reference (whose char-seq is unused;
+    base/core.cljc:146 falls back to seq), this IS the CausalBase
+    flattener's string splitter (cbase.list_to_nodes), so a ZWJ emoji
+    survives transact->edn as one node.
     """
     import unicodedata
 

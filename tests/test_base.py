@@ -19,6 +19,18 @@ def test_cb_to_edn():
     ]
 
 
+def test_string_explosion_is_grapheme_aware():
+    """A ZWJ family emoji survives transact->edn as ONE node — the case
+    the reference documents as known-broken and leaves unwired
+    (util.cljc:94-97, base/core.cljc:146). Plain ASCII still explodes
+    per char."""
+    family = "\U0001F468\u200D\U0001F469\u200D\U0001F467"  # man ZWJ woman ZWJ girl
+    acc_e = "e\u0301"  # e + combining acute
+    cb = b.transact_(b.new_cb(), [[None, None, ["hi" + family + acc_e]]])
+    got = b.cb_to_edn(cb)
+    assert got == ["h", "i", family, acc_e]
+
+
 def test_cb_to_edn_cyclic_ref():
     """A self-referential base renders with the ref left unexpanded at
     the point of recurrence instead of RecursionError — beating the

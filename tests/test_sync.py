@@ -178,6 +178,104 @@ def test_malformed_frames_raise_causal_errors():
     assert "bad-frame" in errs["good"].info["causes"]
 
 
+def test_malformed_version_vector_rejected_as_bad_frame():
+    """A hello frame whose vv is not {site: [ts, tx]} must reject with
+    the protocol's uniform bad-frame CausalError, not leak an
+    AttributeError/TypeError out of delta_nodes."""
+    base = c.clist("x")
+    # (int keys are absent from the matrix: JSON coerces them to
+    # strings in transit, so they arrive well-formed)
+    for bad_vv in ("not-a-dict", {"s": "newest"}, {"s": [1]},
+                   {"s": [1, 2, 3]}, {"s": [1.5, 0]}, {"s": [True, 0]}):
+        s1, s2 = socket.socketpair()
+        errs = {}
+
+        def good(sock):
+            with sock, sock.makefile("rwb") as stream:
+                try:
+                    sync.sync_stream(base, stream)
+                except c.CausalError as e:
+                    errs["good"] = e
+
+        def evil(sock, vv=bad_vv):
+            with sock, sock.makefile("rwb") as stream:
+                ct = base.ct
+                sync.send_frame(stream, {
+                    "op": "hello", "uuid": ct.uuid, "type": ct.type,
+                    "vv": vv,
+                })
+                try:
+                    sync.recv_frame(stream)
+                except c.CausalError:
+                    pass
+
+        t1 = threading.Thread(target=good, args=(s1,), daemon=True)
+        t2 = threading.Thread(target=evil, args=(s2,), daemon=True)
+        t1.start(); t2.start(); t1.join(5); t2.join(5)
+        assert "bad-frame" in errs["good"].info["causes"], bad_vv
+
+
+class _DribbleStream:
+    """A read/write stream that returns at most one byte per read —
+    the short-read behavior of a raw non-blocking-ish transport that
+    buffered makefile() streams hide."""
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def write(self, data):
+        self.buf.extend(data)
+        return len(data)
+
+    def flush(self):
+        pass
+
+    def read(self, n):
+        if not self.buf:
+            return b""
+        out = bytes(self.buf[:1])
+        del self.buf[:1]
+        return out
+
+
+def test_recv_frame_survives_short_reads():
+    stream = _DribbleStream()
+    sync.send_frame(stream, {"op": "done"})
+    assert sync.recv_frame(stream) == {"op": "done"}
+    # true EOF mid-frame still rejects
+    stream2 = _DribbleStream()
+    sync.send_frame(stream2, {"op": "done"})
+    stream2.buf = stream2.buf[:3]  # truncate inside the header
+    with pytest.raises(c.CausalError) as ei:
+        sync.recv_frame(stream2)
+    assert "eof" in ei.value.info["causes"]
+
+
+def test_exchange_frame_surfaces_recv_error_while_send_blocked():
+    """If the receive fails while the helper thread is still blocked
+    writing into a transport the peer never drains, the receive error
+    must surface promptly instead of hanging on the join."""
+    import io
+    import time
+
+    class _BlockedWriter(io.RawIOBase):
+        def write(self, data):
+            time.sleep(60)  # peer never drains
+            return len(data)
+
+        def flush(self):
+            pass
+
+        def read(self, n):
+            return b""  # immediate EOF -> recv_frame raises
+
+    t0 = time.monotonic()
+    with pytest.raises(c.CausalError) as ei:
+        sync.exchange_frame(_BlockedWriter(), {"op": "hello", "pad": "x" * 1024})
+    assert "eof" in ei.value.info["causes"]
+    assert time.monotonic() - t0 < 30, "exchange_frame hung on join"
+
+
 def test_same_ts_tx_run_partial_peer_heals():
     """Ids are (ts, site, tx); one transaction mints same-ts runs. A
     peer holding only a prefix of such a run must still receive the
