@@ -88,8 +88,16 @@ class CausalTree:
     # arbitrary attachment that never affects equality and is not
     # serialized — Clojure metadata semantics.
     meta: Any = field(default=None, compare=False)
+    # CACHE: marshalled device lanes (weaver.lanecache.LaneView), the
+    # fourth disposable cache next to yarns/weave — maintained on the
+    # append fast path, attached by the device weaver after rebuilds,
+    # and cleared by ``evolve`` whenever ``nodes`` changes without an
+    # explicit replacement (so it can never go stale).
+    lanes: Any = field(default=None, compare=False, repr=False)
 
     def evolve(self, **kw) -> "CausalTree":
+        if "nodes" in kw and "lanes" not in kw:
+            kw["lanes"] = None
         return replace(self, **kw)
 
 
@@ -160,24 +168,44 @@ def insert(weave_fn: WeaveFn, ct: CausalTree, node, more_nodes_in_tx=None) -> Ca
     txs = {get_tx(n) for n in nodes}
     if len(txs) > 1:
         raise CausalError("All nodes must belong to the same tx.", {"txs": txs})
-    existing = ct.nodes.get(node[0])
-    if existing is not None:
-        if existing == (node[1], node[2]):
-            return ct  # idempotency!
+    # every node of the run gets the same scrutiny as a single insert —
+    # a run must not be a validation bypass (append-only bodies, causes
+    # resolving in the tree or earlier in the run)
+    dup = 0
+    for nd in nodes:
+        existing = ct.nodes.get(nd[0])
+        if existing is not None:
+            if existing != (nd[1], nd[2]):
+                raise CausalError(
+                    "This node is already in the tree and can't be changed.",
+                    {"causes": {"append-only", "edits-not-allowed"},
+                     "existing_node": (nd[0],) + existing},
+                )
+            dup += 1
+    if dup == len(nodes):
+        return ct  # idempotency!
+    if dup:
         raise CausalError(
-            "This node is already in the tree and can't be changed.",
-            {"causes": {"append-only", "edits-not-allowed"},
-             "existing_node": (node[0],) + existing},
+            "A same-tx run must be all-new or an exact replay.",
+            {"causes": {"append-only", "partial-tx-run"}},
         )
-    if not is_key(node[1]) and node[1] not in ct.nodes:
-        raise CausalError(
-            "The cause of this node is not in the tree.",
-            {"causes": {"cause-must-exist"}},
-        )
+    seen = set()
+    for nd in nodes:
+        if not is_key(nd[1]) and nd[1] not in ct.nodes and nd[1] not in seen:
+            raise CausalError(
+                "The cause of this node is not in the tree.",
+                {"causes": {"cause-must-exist"}},
+            )
+        seen.add(nd[0])
+    lanes0 = ct.lanes
     if node[0][0] > ct.lamport_ts:
         ct = ct.evolve(lamport_ts=node[0][0])
     ct = assoc_nodes(ct, nodes)
     ct = spin(ct, node, more_nodes_in_tx)
+    if lanes0 is not None and ct.type == LIST_TYPE:
+        from ..weaver import lanecache
+
+        ct = ct.evolve(lanes=lanecache.extend_view(lanes0, nodes))
     return weave_fn(ct, node, more_nodes_in_tx)
 
 

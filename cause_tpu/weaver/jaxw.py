@@ -465,21 +465,24 @@ def _run_budget(capacity: int) -> int:
     return max(16, capacity // 8)
 
 
-def weave_arrays(na: NodeArrays) -> Tuple[np.ndarray, np.ndarray]:
+def weave_arrays(na: NodeArrays, segs=None) -> Tuple[np.ndarray, np.ndarray]:
     """Run the device linearization for one tree; returns host-side
     ``(rank, visible)`` numpy arrays. Prefers the v5 segment-union
     kernel — a single tree never explodes a segment, so device work
     collapses to segment scale plus a few full-width scans — then the
     v4 merge kernel (marshal-resolved causes at full width), then the
     chain-compressed v2 and the uncompressed v1 (budget estimates are
-    host-side, so a branchy tree never pays for a doomed dispatch)."""
+    host-side, so a branchy tree never pays for a doomed dispatch).
+    ``segs`` may carry a precomputed ``tree_segments`` table (the lane
+    cache memoizes them per view)."""
     from .jaxw4 import merge_weave_kernel_v4_jit
     from .jaxw5 import merge_weave_kernel_v5_jit
     from .segments import SEG_LANE_KEYS, concat_segments, tree_segments
 
     hi, lo = na.id_lanes()
     k_max = _run_budget(na.capacity)
-    segs = tree_segments(hi, lo, na.cause_idx, na.vclass, na.n)
+    if segs is None:
+        segs = tree_segments(hi, lo, na.cause_idx, na.vclass, na.n)
     n_segs = segs["sg_len"].shape[0]
     if n_segs <= max(16, na.capacity // 4):
         # capacity-derived budget (NOT n_segs-derived): one compile per
@@ -528,18 +531,26 @@ def refresh_list_weave(ct):
     clist.weave). Produces the identical weave list the pure scan
     would. Ids beyond the PackSpec bit layout are off the device
     domain — fall back to the pure rebuild, same stance as nativew's
-    OutsideDomain path, so every backend weaves the same trees."""
-    na = NodeArrays.from_nodes_map(ct.nodes)
-    if not na.spec_ok:
+    OutsideDomain path, so every backend weaves the same trees.
+
+    The marshal goes through the persistent lane cache: a fresh view
+    is reused as-is (appends extended it in place), anything else is
+    rebuilt once and attached to the result, so the NEXT rebuild or
+    merge wave ships cached lanes instead of re-walking the dict."""
+    from . import lanecache
+
+    view = lanecache.view_for(ct)
+    if view is None:
         from ..collections import clist as c_list
 
         return c_list.weave(ct.evolve(weaver="pure")).evolve(
             weaver=ct.weaver
         )
-    rank, _ = weave_arrays(na)
+    na = view.node_arrays()
+    rank, _ = weave_arrays(na, segs=view.segments(na))
     order = np.argsort(rank[: na.capacity], kind="stable")
     weave = [na.nodes[i] for i in order[: na.n]]
-    return ct.evolve(weave=weave)
+    return ct.evolve(weave=weave, lanes=view)
 
 
 def merge_list_trees(ct1, ct2):
@@ -602,7 +613,11 @@ def merge_many_list_trees(cts):
                          "existing_node": (nid,) + nodes[nid]},
                     )
 
-    na = NodeArrays.from_nodes_map(nodes)
+    from . import lanecache
+
+    view = lanecache.build_view(nodes, first.uuid)
+    na = view.node_arrays() if view is not None \
+        else NodeArrays.from_nodes_map(nodes)
     n = na.n
     if na.spec_ok:
         has_cause = na.cause_hi[:n] >= 0
@@ -639,7 +654,7 @@ def merge_many_list_trees(cts):
         # ids beyond the PackSpec: valid fleet, but no device lanes
         return _pure_fleet_fallback(first, cts)
 
-    rank, _ = weave_arrays(na)
+    rank, _ = weave_arrays(na, segs=view.segments(na) if view else None)
     order = np.argsort(rank[: na.capacity], kind="stable")
     weave = [na.nodes[i] for i in order[:n]]
     # na.nodes is already in sorted id order -> yarns group in one pass
@@ -648,7 +663,8 @@ def merge_many_list_trees(cts):
         yarns.setdefault(node[0][1], []).append(node)
     lamport = max(first.lamport_ts, int(na.ts[:n].max(initial=0)))
     return first.evolve(
-        nodes=nodes, yarns=yarns, weave=weave, lamport_ts=lamport
+        nodes=nodes, yarns=yarns, weave=weave, lamport_ts=lamport,
+        lanes=view,
     )
 
 

@@ -1,0 +1,413 @@
+"""Persistent per-handle device-lane caches.
+
+The device marshal (``NodeArrays.from_nodes_map`` + ``tree_segments``)
+used to be recomputed from the Python node dicts on every merge wave,
+even though a tree's lanes and chain runs are a static per-tree fact
+that each op changes only incrementally. The reference's whole design
+is incremental caches — yarns and weave are maintained per-op and only
+rebuilt from the bag of nodes on demand (shared.cljc:9-12,121-149);
+this module gives the device lanes the same discipline:
+
+- a ``LaneArena`` is an append-only structure-of-arrays store of one
+  tree's marshalled lanes (the ``NodeArrays`` columns), shared across
+  tree versions the way persistent vectors share tails: a ``LaneView``
+  is ``(arena, n)`` and owning the arena tip lets an append extend in
+  place (amortized O(k) per op); a non-tip extend copies first.
+- appends are the common case by construction: a freshly minted node's
+  lamport-ts exceeds every ts in the tree (``shared.insert`` fast-
+  forwards the clock), so ``conj``/``extend``/``append`` always add
+  lanes in ascending id order. Anything else — foreign mid-order
+  inserts, wefts — drops the cache; the next device use rebuilds it
+  lazily from the node dict (always correct, never stale: see
+  ``CausalTree.evolve``, which clears ``lanes`` whenever ``nodes``
+  changes without an explicit new cache).
+- site-id ranks come from a per-collection-uuid ``SharedInterner``
+  with *gapped* ranks, so every replica of one document in the process
+  packs ids identically — a batched merge wave can ship cached lanes
+  from many replicas straight into one kernel with no re-ranking —
+  and a new site almost never disturbs existing ranks (it takes the
+  midpoint of its neighbors' gap; only gap exhaustion forces a global
+  reassignment, which bumps a generation stamp that invalidates
+  stale-ranked arenas).
+- per-view segment tables (``tree_segments``) are memoized on the
+  arena, so a merge wave ships cached segment tables too.
+
+The cache is only ever an accelerator: every consumer falls back to
+``NodeArrays.from_nodes_map`` when a view is absent, stale, or outside
+the PackSpec domain, and the invalidation fuzz suite asserts cached
+lanes are indistinguishable from from-scratch lanes after arbitrary op
+sequences (tests/test_lanecache.py).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from .arrays import (
+    DEFAULT_PACK,
+    NodeArrays,
+    PackSpec,
+    vclass_of,
+    next_pow2,
+)
+from ..ids import is_id
+
+__all__ = [
+    "SharedInterner",
+    "interner_for",
+    "LaneArena",
+    "LaneView",
+    "build_view",
+    "extend_view",
+    "view_for",
+]
+
+
+_RANK_CEIL = (1 << DEFAULT_PACK.site_bits) - 1  # rank 2^18-1 is reserved
+# (the all-ones lo packing is the padding sentinel, arrays.PackSpec)
+
+
+class SharedInterner:
+    """Order-preserving site-id -> rank map shared by every replica of
+    one collection uuid in this process.
+
+    Ranks are *gapped*: sites spread over the 18-bit rank space so a
+    new site takes the midpoint of its neighbors' gap and existing
+    assignments never move — which is what keeps independently grown
+    replica caches mutually comparable (same string, same rank, in
+    every arena). When a gap is exhausted all ranks are reassigned
+    evenly and ``generation`` bumps; arenas stamped with an older
+    generation re-rank lazily (their internal order stays valid — the
+    reassignment is order-preserving — but they can no longer be mixed
+    with fresh lanes in one kernel invocation).
+
+    ``len()`` reports ``max_rank + 1`` so ``PackSpec.check``'s site
+    bound covers the gapped layout, and ``NodeArrays``' one-past-the-
+    end ghost rank stays collision-free.
+    """
+
+    __slots__ = ("sites", "rank", "generation", "_lock")
+
+    def __init__(self):
+        self.sites: List[str] = []
+        self.rank: Dict[str, int] = {}
+        self.generation = 0
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        if not self.sites:
+            return 0
+        return max(self.rank[s] for s in self.sites) + 1
+
+    def __contains__(self, site: str) -> bool:
+        return site in self.rank
+
+    def _reassign(self) -> None:
+        # bump the generation BEFORE swapping the dict: a reader that
+        # captures the new dict is then guaranteed to see the bumped
+        # generation and bail (extend_view's capture-then-check), while
+        # one that captured the old dict writes old-generation ranks
+        # that its arena stamp still matches
+        step = max(1, _RANK_CEIL // (len(self.sites) + 1))
+        self.generation += 1
+        self.rank = {s: (i + 1) * step for i, s in enumerate(self.sites)}
+
+    def ensure(self, sites) -> int:
+        """Intern any missing sites; returns the (possibly bumped)
+        generation."""
+        missing = sorted(set(s for s in sites if s not in self.rank))
+        if not missing:
+            return self.generation
+        with self._lock:
+            for s in missing:
+                if s in self.rank:
+                    continue
+                pos = bisect.bisect_left(self.sites, s)
+                lo = self.rank[self.sites[pos - 1]] if pos > 0 else -1
+                hi = (
+                    self.rank[self.sites[pos]]
+                    if pos < len(self.sites)
+                    else _RANK_CEIL
+                )
+                mid = (lo + hi) // 2
+                self.sites.insert(pos, s)
+                if mid <= lo or mid >= hi:
+                    self._reassign()  # gap exhausted: spread + new gen
+                else:
+                    self.rank[s] = mid
+        return self.generation
+
+
+_REGISTRY: Dict[str, SharedInterner] = {}
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY_CAP = 4096
+
+
+def interner_for(uuid: str) -> SharedInterner:
+    """The process-wide shared interner of one collection uuid."""
+    it = _REGISTRY.get(uuid)
+    if it is None:
+        with _REGISTRY_LOCK:
+            it = _REGISTRY.get(uuid)
+            if it is None:
+                if len(_REGISTRY) >= _REGISTRY_CAP:
+                    # drop ~half, oldest-inserted first (dict order);
+                    # evicted uuids simply mint a fresh interner (their
+                    # existing arenas keep a reference and stay valid)
+                    for k in list(_REGISTRY)[: _REGISTRY_CAP // 2]:
+                        del _REGISTRY[k]
+                it = SharedInterner()
+                _REGISTRY[uuid] = it
+    return it
+
+
+class LaneArena:
+    """Append-only lane arena shared by successive versions of one
+    tree. ``committed_n`` is the arena tip: a view owning the tip may
+    extend in place; any other extension copies into a fresh arena
+    first (so sibling branches can never see each other's lanes)."""
+
+    __slots__ = (
+        "ts", "site", "tx", "cause_idx", "vclass", "cause_hi", "cause_lo",
+        "nodes", "lane_of", "interner", "generation", "spec",
+        "committed_n", "seg_cache", "lock",
+    )
+
+    def __init__(self, ts, site, tx, cause_idx, vclass, cause_hi, cause_lo,
+                 nodes, lane_of, interner, generation, spec, committed_n):
+        self.ts = ts
+        self.site = site
+        self.tx = tx
+        self.cause_idx = cause_idx
+        self.vclass = vclass
+        self.cause_hi = cause_hi
+        self.cause_lo = cause_lo
+        self.nodes = nodes          # list of (id, cause, value), lane order
+        self.lane_of = lane_of      # {id: lane}
+        self.interner = interner
+        self.generation = generation
+        self.spec = spec
+        self.committed_n = committed_n
+        self.seg_cache = {}         # {n: tree_segments result}
+        self.lock = threading.Lock()
+
+    @property
+    def capacity(self) -> int:
+        return int(self.ts.shape[0])
+
+
+class LaneView:
+    """An immutable (arena, n) snapshot — the ``lanes`` cache slot of
+    one ``CausalTree`` version."""
+
+    __slots__ = ("arena", "n")
+
+    def __init__(self, arena: LaneArena, n: int):
+        self.arena = arena
+        self.n = n
+
+    @property
+    def generation(self) -> int:
+        return self.arena.generation
+
+    @property
+    def interner(self) -> SharedInterner:
+        return self.arena.interner
+
+    def node_arrays(self) -> NodeArrays:
+        """A ``NodeArrays`` over this view. Lanes at or beyond ``n``
+        may hold a newer version's data in the shared arena, so every
+        column is masked to the view (cheap vectorized copies)."""
+        a, n, cap = self.arena, self.n, self.arena.capacity
+        valid = np.zeros(cap, bool)
+        valid[:n] = True
+        return NodeArrays(
+            ts=np.where(valid, a.ts, 0),
+            site=np.where(valid, a.site, 0),
+            tx=np.where(valid, a.tx, 0),
+            cause_idx=np.where(valid, a.cause_idx, -1),
+            vclass=np.where(valid, a.vclass, 0),
+            valid=valid,
+            cause_hi=np.where(valid, a.cause_hi, -1),
+            cause_lo=np.where(valid, a.cause_lo, -1),
+            nodes=a.nodes[:n],
+            interner=a.interner,
+            n=n,
+            spec=a.spec,
+            spec_ok=True,
+        )
+
+    def segments(self, na: Optional[NodeArrays] = None):
+        """Memoized ``tree_segments`` of this view (the per-tree chain
+        tables the v5 kernel unions). Pass the ``node_arrays()`` you
+        already built to skip re-masking the columns on a miss."""
+        segs = self.arena.seg_cache.get(self.n)
+        if segs is None:
+            from .segments import tree_segments
+
+            if na is None:
+                na = self.node_arrays()
+            hi, lo = na.id_lanes()
+            segs = tree_segments(hi, lo, na.cause_idx, na.vclass, na.n)
+            with self.arena.lock:
+                cache = self.arena.seg_cache
+                if len(cache) >= 4:
+                    try:
+                        cache.pop(min(cache))
+                    except (ValueError, KeyError):
+                        pass  # concurrent evictor got there first
+                cache[self.n] = segs
+        return segs
+
+
+def _arena_from_node_arrays(na: NodeArrays, interner: SharedInterner,
+                            generation: int) -> LaneArena:
+    return LaneArena(
+        ts=na.ts.copy(), site=na.site.copy(), tx=na.tx.copy(),
+        cause_idx=na.cause_idx.copy(), vclass=na.vclass.copy(),
+        cause_hi=na.cause_hi.copy(), cause_lo=na.cause_lo.copy(),
+        nodes=list(na.nodes),
+        lane_of={nid: i for i, (nid, _, _) in enumerate(na.nodes)},
+        interner=interner, generation=generation, spec=na.spec,
+        committed_n=na.n,
+    )
+
+
+def build_view(nodes_map: dict, uuid: str,
+               spec: PackSpec = DEFAULT_PACK) -> Optional[LaneView]:
+    """Marshal a node dict into a fresh cached view (shared-interner
+    ranks). Returns None when the ids are outside the PackSpec domain
+    — callers keep their existing from-scratch fallbacks."""
+    interner = interner_for(uuid)
+    gen = interner.ensure(nid[1] for nid in nodes_map)
+    na = NodeArrays.from_nodes_map(
+        nodes_map, capacity=next_pow2(len(nodes_map)),
+        interner=interner, spec=spec,
+    )
+    if not na.spec_ok:
+        return None
+    return LaneView(_arena_from_node_arrays(na, interner, gen), na.n)
+
+
+def _copy_arena(view: LaneView, min_capacity: int) -> LaneArena:
+    a, n = view.arena, view.n
+    cap = next_pow2(min_capacity)
+
+    def grow(arr, fill):
+        out = np.full(cap, fill, arr.dtype)
+        out[:n] = arr[:n]
+        return out
+
+    return LaneArena(
+        ts=grow(a.ts, 0), site=grow(a.site, 0), tx=grow(a.tx, 0),
+        cause_idx=grow(a.cause_idx, -1), vclass=grow(a.vclass, 0),
+        cause_hi=grow(a.cause_hi, -1), cause_lo=grow(a.cause_lo, -1),
+        nodes=a.nodes[:n],
+        lane_of={nid: i for i, (nid, _, _) in enumerate(a.nodes[:n])},
+        interner=a.interner, generation=a.generation, spec=a.spec,
+        committed_n=n,
+    )
+
+
+def extend_view(view: Optional[LaneView], new_nodes) -> Optional[LaneView]:
+    """Append freshly inserted nodes to a cached view.
+
+    Applies only to the append fast path: every new id must exceed the
+    view's tail id and arrive in ascending order (what ``conj`` /
+    ``extend`` / ``append`` mint, since the lamport clock fast-forwards
+    past every known ts). Anything else — mid-order foreign inserts, a
+    site whose interning reassigned ranks, ids beyond the PackSpec —
+    returns None and the cache is simply dropped (rebuilt lazily).
+    """
+    if view is None:
+        return None
+    arena = view.arena
+    interner = arena.interner
+    if interner.generation != arena.generation:
+        return None  # ranks reassigned since this arena was built
+    n = view.n
+    tail = arena.nodes[n - 1][0] if n > 0 else None
+    prev = tail
+    for nd in new_nodes:
+        if prev is not None and nd[0] <= prev:
+            return None
+        prev = nd[0]
+    gen = interner.ensure(nd[0][1] for nd in new_nodes)
+    if gen != arena.generation:
+        return None
+    k = len(new_nodes)
+    spec = arena.spec
+    try:
+        spec.check(
+            max(nd[0][0] for nd in new_nodes),
+            len(interner),
+            max(max(nd[0][2] for nd in new_nodes),
+                max((nd[1][2] for nd in new_nodes if is_id(nd[1])),
+                    default=0)),
+        )
+    except OverflowError:
+        return None
+
+    # resolve every id cause BEFORE mutating anything (a mid-append
+    # bail would leave the arena corrupt). The shared lane_of may hold
+    # a sibling branch's lanes at index >= n — those are NOT ours.
+    pos = {nd[0]: n + j for j, nd in enumerate(new_nodes)}
+    cause_lane = []
+    for nd in new_nodes:
+        c = nd[1]
+        if is_id(c):
+            c = tuple(c)
+            ci = pos.get(c)
+            if ci is None:
+                ci = arena.lane_of.get(c)
+                if ci is None or ci >= n:
+                    return None  # dangling / foreign-branch cause
+            cause_lane.append(ci)
+        else:
+            cause_lane.append(-1)
+
+    with arena.lock:
+        if arena.committed_n != n or n + k > arena.capacity:
+            arena = _copy_arena(view, n + k)
+        # capture-then-check: a concurrent gap-exhaustion reassignment
+        # swaps the rank dict after bumping the generation, so a rank
+        # dict captured under a still-matching generation is guaranteed
+        # to carry this arena's generation of ranks
+        rank = interner.rank
+        if interner.generation != arena.generation:
+            return None
+        lane_of = arena.lane_of
+        i = n
+        for (nid, cause, value), ci in zip(new_nodes, cause_lane):
+            arena.ts[i] = nid[0]
+            arena.site[i] = rank[nid[1]]
+            arena.tx[i] = nid[2]
+            arena.vclass[i] = vclass_of(value)
+            arena.cause_idx[i] = ci
+            if ci >= 0:
+                arena.cause_hi[i] = cause[0]
+                arena.cause_lo[i] = spec.pack_lo(
+                    np.int32(rank.get(cause[1], len(interner))),
+                    np.int32(cause[2]),
+                )
+            else:
+                arena.cause_hi[i] = -1
+                arena.cause_lo[i] = -1
+            arena.nodes.append((nid, cause, value))
+            lane_of[nid] = i
+            i += 1
+        arena.committed_n = n + k
+    return LaneView(arena, n + k)
+
+
+def view_for(ct) -> Optional[LaneView]:
+    """The tree's cached view if fresh, else a new build (list trees
+    only). None when the tree is outside the cacheable domain."""
+    view = getattr(ct, "lanes", None)
+    if isinstance(view, LaneView) and view.n == len(ct.nodes):
+        return view
+    return build_view(ct.nodes, ct.uuid)

@@ -16,9 +16,10 @@ over the lanes, same cost class as building the lanes themselves):
 
 - ``run_of_lane``: each lane's segment ordinal;
 - per-segment tables: head lane, length, head id (= min id), tail id
-  (= max id), a *dense* flag (ids are consecutive-ts, same-site, tx 0 —
-  exactly the shape ``conj``/``extend`` chains mint), and whether the
-  tail is special (trailing tombstone chain);
+  (= max id), a *dense* flag (member ids fully determined by
+  (min, max, len): consecutive-ts conj chains or same-ts tx-index runs
+  — the shapes ``conj`` and ``extend`` mint), and whether the tail is
+  special (trailing tombstone chain);
 - the root is always forced into its own singleton segment so the
   root+base prefix shared by every replica stays wholesale-dedupable
   (the root id's packed lo differs from the chain site's, which would
@@ -44,7 +45,9 @@ SEG_KEYS = (
     "sg_len",        # member count
     "sg_min_hi", "sg_min_lo",   # head id (the minimum member id)
     "sg_max_hi", "sg_max_lo",   # tail id (the maximum member id)
-    "sg_dense",      # ids are (hi..hi+len-1, constant lo): exact-dedupe ok
+    "sg_dense",      # member ids determined by (min, max, len): either
+                     # (hi..hi+len-1, constant lo) conj chains or
+                     # (constant hi, lo..lo+len-1) tx runs; dedupe ok
     "sg_tail_special",  # tail lane carries a special (tombstone suffix)
 )
 
@@ -105,10 +108,26 @@ def tree_segments(hi, lo, cause_idx, vclass, n: int) -> Dict[str, np.ndarray]:
     # shared prefix flows into site-local edits — without the split,
     # the shared base would glue into the divergent suffix and lose
     # its wholesale-dedupe (the union kernel re-glues tokens, so extra
-    # boundaries never change the final weave)
-    dense_ok = np.ones(n, bool)
-    dense_ok[1:] = (lo[1:n] == lo[: n - 1]) & (hi[1:n] == hi[: n - 1] + 1)
+    # boundaries never change the final weave). TWO dense patterns:
+    # consecutive-ts conj chains (hi+1, lo constant) and same-tx extend
+    # runs (hi constant, lo+1 — one transaction's tx-index run, the
+    # API's bulk paste path, list.cljc:23-25 analogue)
+    dense_hi = np.zeros(n, bool)
+    dense_lo = np.zeros(n, bool)
+    dense_hi[1:] = (lo[1:n] == lo[: n - 1]) & (hi[1:n] == hi[: n - 1] + 1)
+    dense_lo[1:] = (hi[1:n] == hi[: n - 1]) & (lo[1:n] == lo[: n - 1] + 1)
+    dense_ok = dense_hi | dense_lo
+    dense_ok[0] = True
     glued &= dense_ok
+    # dedupe soundness: a dense run's member ids must be fully
+    # determined by (min, max, len), which holds only when the whole
+    # run follows ONE pattern (for len > 1 the endpoints reveal which:
+    # exactly one of max_hi == min_hi / max_lo == min_lo). Cut the
+    # second of any two consecutive glued pairs whose patterns differ.
+    if n > 2:
+        alt = np.zeros(n, bool)
+        alt[2:] = glued[2:] & glued[1:-1] & (dense_lo[2:] != dense_lo[1:-1])
+        glued &= ~alt
     # the root is always a singleton segment (its packed lo differs
     # from any chain site's, so a root-headed run could never be dense)
     if n > 1:
@@ -129,10 +148,12 @@ def tree_segments(hi, lo, cause_idx, vclass, n: int) -> Dict[str, np.ndarray]:
     sg_max_hi = hi[:n][tail_lane].astype(np.int32)
     sg_max_lo = lo[:n][tail_lane].astype(np.int32)
 
-    # dense: constant lo along the run and hi advancing by exactly 1.
-    # The density-break glue split makes every multi-lane run dense by
-    # construction; keep the aggregate check anyway (robustness against
-    # a future glue-rule change silently losing the invariant)
+    # dense: every adjacent pair follows one of the two dense patterns
+    # (hi+1/lo-const conj chains or hi-const/lo+1 tx runs), uniform
+    # along the run via the alternation cut above. The glue split makes
+    # every multi-lane run dense by construction; keep the aggregate
+    # check anyway (robustness against a future glue-rule change
+    # silently losing the invariant)
     bad = ~dense_ok & ~run_start  # the head lane never breaks its run
     bad_runs = np.zeros(n_runs, bool)
     bad_runs[rid[bad]] = True

@@ -1,0 +1,197 @@
+"""Lane-cache correctness: cached lanes must be indistinguishable from
+from-scratch lanes after ANY op sequence (the invalidation oracle —
+the lane twin of the reference's cache-idempotency fuzzers,
+list_test.cljc:34-41), branches must not leak into each other's
+arenas, and rank reassignment must invalidate stale arenas."""
+
+import random
+
+import numpy as np
+import pytest
+
+import cause_tpu as c
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id, ROOT_ID
+from cause_tpu.weaver import lanecache
+from cause_tpu.weaver.arrays import NodeArrays
+
+
+def assert_view_matches_scratch(ct):
+    """The semantic-equality oracle: a cached view and a from-scratch
+    marshal must agree on everything a kernel consumes. Site ranks may
+    differ numerically (gapped shared interner vs dense per-call
+    interner) but must induce the same order."""
+    view = ct.lanes
+    if view is None:
+        return
+    assert view.n == len(ct.nodes), "stale view survived"
+    na_c = view.node_arrays()
+    na_f = NodeArrays.from_nodes_map(ct.nodes)
+    assert na_c.nodes == na_f.nodes
+    n = na_f.n
+    assert np.array_equal(na_c.ts[:n], na_f.ts[:n])
+    assert np.array_equal(na_c.tx[:n], na_f.tx[:n])
+    assert np.array_equal(na_c.vclass[:n], na_f.vclass[:n])
+    assert np.array_equal(na_c.cause_idx[:n], na_f.cause_idx[:n])
+    assert np.array_equal(na_c.valid, na_f.valid)
+    # rank order parity: lexsort of (hi, lo) must agree
+    hi_c, lo_c = na_c.id_lanes()
+    hi_f, lo_f = na_f.id_lanes()
+    assert np.array_equal(np.lexsort((lo_c, hi_c)),
+                          np.lexsort((lo_f, hi_f)))
+    # cause lanes resolve to the same lanes through packed search
+    cl_c = na_c.cause_lanes()
+    ok_c = cl_c[0][:n] >= 0
+    cl_f = na_f.cause_lanes()
+    ok_f = cl_f[0][:n] >= 0
+    assert np.array_equal(ok_c, ok_f)
+
+
+def warm(cl):
+    """Force a device rebuild so the cache exists (it is created lazily
+    by the jax weaver, never by pure edits)."""
+    return CausalList(c_list.weave(cl.ct))
+
+
+def test_append_extend_conj_maintain_cache():
+    cl = warm(c.clist(weaver="jax").extend(["x"] * 50))
+    assert cl.ct.lanes is not None
+    cl = cl.conj("a", "b").extend(["c"] * 7).cons("front")
+    # cons inserts at root with a NEW max ts -> still an append in id
+    # order, so the cache extends
+    assert cl.ct.lanes is not None
+    assert_view_matches_scratch(cl.ct)
+    # weave parity vs pure after cached rebuild
+    ref = c_list.weave(cl.ct.evolve(weaver="pure")).weave
+    assert c_list.weave(cl.ct).weave == ref
+
+
+def test_evolve_nodes_clears_lanes():
+    cl = warm(c.clist(weaver="jax").extend(["x"] * 10))
+    assert cl.ct.lanes is not None
+    ct2 = cl.ct.evolve(nodes=dict(cl.ct.nodes))
+    assert ct2.lanes is None
+    ct3 = cl.ct.evolve(weave=list(cl.ct.weave))
+    assert ct3.lanes is not None  # non-nodes evolve keeps the cache
+
+
+def test_foreign_midorder_insert_drops_cache():
+    cl = warm(c.clist(weaver="jax").extend(["x"] * 10))
+    assert cl.ct.lanes is not None
+    # a foreign node whose id sorts into the middle of the id order
+    # (ts 0 with a site above "0": after the root, before the run)
+    foreign = ((0, "zzzzzzzzzzzzz", 0), ROOT_ID, "old")
+    cl2 = cl.insert(foreign)
+    assert cl2.ct.lanes is None  # dropped, not silently wrong
+    assert_view_matches_scratch(warm(cl2).ct)
+
+
+def test_branch_isolation():
+    base = warm(c.clist(weaver="jax").extend(["x"] * 20))
+    a = base.conj("A1").conj("A2")
+    b = base.extend(["B1", "B2", "B3"])
+    for h in (base, a, b):
+        assert_view_matches_scratch(h.ct)
+    assert c.causal_to_edn(a)[-2:] == ["A1", "A2"]
+    assert c.causal_to_edn(b)[-3:] == ["B1", "B2", "B3"]
+
+
+def test_merge_attaches_cache_and_matches():
+    base = c.clist(weaver="jax").extend(["x"] * 30)
+    a = CausalList(base.ct.evolve(site_id=new_site_id())).extend(["a"] * 9)
+    b = CausalList(base.ct.evolve(site_id=new_site_id())).extend(["b"] * 9)
+    m = a.merge(b)
+    assert m.ct.lanes is not None
+    assert_view_matches_scratch(m.ct)
+    ref = a.ct.evolve(weaver="pure")
+    got_ref = c.causal_to_edn(
+        CausalList(ref).merge(CausalList(b.ct.evolve(weaver="pure")))
+    )
+    assert c.causal_to_edn(m) == got_ref
+
+
+def test_rank_reassignment_invalidates_stale_arenas(monkeypatch):
+    monkeypatch.setattr(lanecache, "_RANK_CEIL", 8)
+    it = lanecache.SharedInterner()
+    g0 = it.ensure(["m"])
+    # squeeze sites between until the gap exhausts and ranks reassign
+    names = ["f", "i", "k", "l", "g", "h", "j"]
+    gen = g0
+    for nm in names:
+        gen = it.ensure([nm])
+    assert gen > g0, "gap exhaustion must bump the generation"
+    # order stays correct through reassignment
+    ranks = [it.rank[s] for s in sorted(it.rank)]
+    assert ranks == sorted(ranks)
+
+    class FakeArena:
+        pass
+
+    view = lanecache.LaneView.__new__(lanecache.LaneView)
+    arena = FakeArena()
+    arena.interner = it
+    arena.generation = g0  # stale stamp
+    arena.nodes = [(
+        (1, "m", 0), None, None
+    )]
+    view.arena = arena
+    view.n = 1
+    assert lanecache.extend_view(
+        view, [((2, "m", 0), (1, "m", 0), "v")]
+    ) is None
+
+
+@pytest.mark.slow
+def test_invalidation_fuzz():
+    """Random op soup; after every op the cache (if present) must match
+    a from-scratch marshal, and the rendered document must match the
+    pure backend replaying the same ops."""
+    rng = random.Random(40)
+    for round_ in range(8):
+        cl = warm(c.clist(weaver="jax").extend(
+            [f"s{i}" for i in range(rng.randrange(1, 30))]
+        ))
+        pure = CausalList(cl.ct.evolve(weaver="pure"))
+        fork = None
+        for step in range(rng.randrange(5, 18)):
+            op = rng.randrange(7)
+            if op == 0:
+                vals = [f"v{round_}.{step}.{j}"
+                        for j in range(rng.randrange(1, 6))]
+                cl, pure = cl.extend(vals), pure.extend(vals)
+            elif op == 1:
+                cl, pure = cl.conj(f"c{step}"), pure.conj(f"c{step}")
+            elif op == 2:
+                cl, pure = cl.cons(f"f{step}"), pure.cons(f"f{step}")
+            elif op == 3 and len(cl.ct.weave) > 2:
+                # tombstone a random weave node (a hide append)
+                target = rng.choice(cl.ct.weave[1:])[0]
+                cl = cl.append(target, c.hide)
+                pure = pure.append(target, c.hide)
+            elif op == 4:
+                fork = CausalList(
+                    cl.ct.evolve(site_id=new_site_id())
+                ).extend([f"fk{step}"])
+            elif op == 5 and fork is not None:
+                cl = cl.merge(fork)
+                pure = CausalList(
+                    pure.merge(
+                        CausalList(fork.ct.evolve(weaver="pure"))
+                    ).ct.evolve(weaver="pure")
+                )
+                fork = None
+            else:
+                # foreign mid-order insert (drops the cache)
+                nid = (1, new_site_id(), 0)
+                node = (nid, ROOT_ID, f"mid{step}")
+                cl, pure = cl.insert(node), pure.insert(node)
+            assert_view_matches_scratch(cl.ct)
+            assert c.causal_to_edn(cl) == c.causal_to_edn(pure), (
+                round_, step, op
+            )
+        # final full-rebuild parity + cache attach
+        cl2 = warm(cl)
+        assert cl2.ct.lanes is not None
+        assert_view_matches_scratch(cl2.ct)
+        assert c.causal_to_edn(cl2) == c.causal_to_edn(pure)

@@ -380,3 +380,51 @@ def test_merge_rand_multi_site():
     assert merged_fwd.get_nodes() == merged_rev.get_nodes()
     assert merged_fwd.causal_to_edn() == merged_rev.causal_to_edn()
     assert_idempotent(merged_fwd)
+
+
+def test_tx_run_validation_is_not_a_bypass():
+    """Every node of a same-tx run gets single-insert scrutiny: a run
+    must not silently overwrite existing bodies (append-only), leave
+    dangling causes, or replay partially."""
+    cl = c.clist("a", "b")
+    site = cl.get_site_id()
+    existing_id = [nid for nid in cl.get_nodes() if nid != ROOT_ID][0]
+
+    # run whose SECOND node has a dangling cause
+    bad_cause = [
+        ((9, site, 0), existing_id, "x"),
+        ((9, site, 1), (7, "nowhere______", 0), "y"),
+    ]
+    with pytest.raises(c.CausalError) as ei:
+        cl.insert(bad_cause[0], bad_cause[1:])
+    assert "cause-must-exist" in ei.value.info["causes"]
+
+    # chained causes within the run are fine; full replay is idempotent
+    good = [
+        ((9, site, 0), existing_id, "g0"),
+        ((9, site, 1), (9, site, 0), "g1"),
+    ]
+    cl2 = cl.insert(good[0], good[1:])
+    cl3 = cl2.insert(good[0], good[1:])
+    assert cl3.get_nodes() == cl2.get_nodes()
+
+    # run whose SECOND node collides with an existing body (same tx):
+    # rejected atomically, nothing half-applied
+    evil = [
+        ((9, site, 0), existing_id, "g0"),
+        ((9, site, 1), (9, site, 0), "EVIL"),
+    ]
+    with pytest.raises(c.CausalError) as ei:
+        cl2.insert(evil[0], evil[1:])
+    assert "append-only" in ei.value.info["causes"]
+    assert cl2.get_nodes()[(9, site, 1)][1] == "g1"
+
+    # partial replay (one old node, one new) is rejected, not silently
+    # half-applied
+    partial = [
+        ((9, site, 1), (9, site, 0), "g1"),
+        ((9, site, 2), (9, site, 1), "g2"),
+    ]
+    with pytest.raises(c.CausalError) as ei:
+        cl2.insert(partial[0], partial[1:])
+    assert "partial-tx-run" in ei.value.info["causes"]
