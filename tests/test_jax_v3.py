@@ -21,6 +21,10 @@ from cause_tpu.weaver.arrays import NodeArrays
 
 from test_list import rand_node
 
+# Heavy differential-fuzz suite: CI runs it as a dedicated job;
+# the fast default set keeps tiny-shape coverage in test_jax_smoke.py
+pytestmark = pytest.mark.slow
+
 
 def v1_v3_match(args, k_max):
     o1, r1, v1, c1 = jaxw.merge_weave_kernel(*args)

@@ -21,6 +21,10 @@ from cause_tpu.weaver.arrays import NodeArrays
 
 from test_list import rand_node
 
+# Heavy differential-fuzz suite: CI runs it as a dedicated job;
+# the fast default set keeps tiny-shape coverage in test_jax_smoke.py
+pytestmark = pytest.mark.slow
+
 
 def v1_v4_match(args_v1, args_v4, k_max):
     o1, r1, v1, c1 = jaxw.merge_weave_kernel(*args_v1)

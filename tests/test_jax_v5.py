@@ -19,6 +19,10 @@ from cause_tpu.weaver.arrays import NodeArrays, SiteInterner
 
 from test_list import rand_node
 
+# Heavy differential-fuzz suite: CI runs it as a dedicated job;
+# the fast default set keeps tiny-shape coverage in test_jax_smoke.py
+pytestmark = pytest.mark.slow
+
 
 def v1_concat(args_v1):
     """v1 outputs mapped to concat-lane coordinates."""

@@ -48,6 +48,7 @@ def test_empty_and_tiny_trees():
     assert jax_weave_of(cl.ct) == pure_weave_of(cl.ct)
 
 
+@pytest.mark.slow
 def test_fuzz_parity():
     rng = random.Random(0xBEEF)
     for round_ in range(60):
@@ -68,6 +69,7 @@ def test_jax_weaver_end_to_end():
     assert refreshed.weave == cl.ct.weave
 
 
+@pytest.mark.slow
 def test_merge_parity():
     rng = random.Random(99)
     for _ in range(20):
@@ -167,6 +169,7 @@ def decode_device_weave(order_row, rank_row, all_nodes, visible_row=None):
     return weave, [n for _, n in vis]
 
 
+@pytest.mark.slow
 def test_linearize_v2_parity():
     """The chain-compressed linearizer matches v1 on the regression
     corpus, fuzz trees, and append-only chains (its best case)."""
@@ -241,6 +244,7 @@ def test_jax_map_end_to_end():
     assert c.cmap(weaver="jax").causal_to_edn() == {}
 
 
+@pytest.mark.slow
 def test_estimate_runs_device_parity():
     """The host run estimator equals the device kernel's n_runs EXACTLY
     on fuzz trees: k_max=estimate never overflows, k_max=estimate-1
@@ -266,6 +270,7 @@ def test_estimate_runs_device_parity():
             assert bool(ovf), f"round {round_}: estimate {est} underestimates"
 
 
+@pytest.mark.slow
 def test_pair_run_budget_derived_from_lanes():
     """estimate_pair_runs (numpy front-half + estimate_runs) equals the
     merge kernel's device n_runs on generated pairs, and the derived
@@ -346,6 +351,7 @@ def test_linearize_v2_overflow_flag():
     assert np.array_equal(np.asarray(r1), np.asarray(r2))
 
 
+@pytest.mark.slow
 def test_batched_merge_v2_parity():
     """The compressed batched merge kernel equals the v1 kernel."""
     rng = random.Random(77)

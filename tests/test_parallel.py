@@ -37,6 +37,7 @@ def test_mesh_has_8_virtual_devices():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.slow
 def test_sharded_merge_matches_pure():
     _require_multi_device()
     rng = random.Random(5150)
@@ -83,10 +84,11 @@ def test_sharded_merge_matches_pure():
         {k: np.asarray(lanes[k]) for k in bg.LANE_KEYS4}, cap
     )
     u5 = bg.v5_token_budget(v5lanes)
-    r5, v5_, d5, tv5, nc5, no5 = sharded_merge_weave_v5(
+    r5, v5_, ov5, d5, tv5, nc5, no5 = sharded_merge_weave_v5(
         mesh, v5lanes, u_max=u5, k_max=u5
     )
     assert int(no5) == 0 and int(nc5) == 0
+    assert not bool(np.asarray(ov5).any())
     assert int(tv5) == int(total_visible)
     assert np.array_equal(np.asarray(d5), np.asarray(digest))
     # rank equivalence through the coordinate change
