@@ -9,3 +9,4 @@ from .mesh import (  # noqa: F401
     sharded_merge_weave_v4,
     sharded_merge_weave_v5,
 )
+from .wave import WaveResult, merge_wave  # noqa: F401

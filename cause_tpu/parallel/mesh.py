@@ -212,7 +212,7 @@ def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int):
         _shard_map,
         mesh=mesh,
         in_specs=(sharded,) * 15,
-        out_specs=(sharded, sharded, sharded, replicated,
+        out_specs=(sharded, sharded, sharded, sharded, replicated,
                    replicated, replicated),
     )
     def step(hi, lo, cci, vc, va, seg, *sg):
@@ -222,8 +222,11 @@ def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int):
         digest, total_visible, n_conflicts, n_overflow = _fleet_reductions(
             axis, hi, lo, rank, visible, conflict, overflow
         )
-        return (rank, visible, digest, total_visible, n_conflicts,
-                n_overflow)
+        # per-row overflow rides out sharded: overflowed v5 rows keep
+        # many plausible-looking ranks, so callers cannot reconstruct
+        # the flags from rank alone
+        return (rank, visible, overflow, digest, total_visible,
+                n_conflicts, n_overflow)
 
     return jax.jit(step)
 
@@ -232,9 +235,10 @@ def sharded_merge_weave_v5(mesh: Mesh, lanes: dict, u_max: int,
                            k_max: int):
     """Shard the v5 segment-union merge over the mesh. ``lanes`` is the
     ``benchgen.LANE_KEYS5`` dict of [B, ...] arrays. Returns
-    ``(rank, visible, digest, total_visible, n_conflicts, n_overflow)``
-    — rank/visible per concat lane (no order array in the v5
-    contract).
+    ``(rank, visible, overflow, digest, total_visible, n_conflicts,
+    n_overflow)`` — rank/visible/overflow per replica row (no order
+    array in the v5 contract; ``overflow`` rows carry garbage ranks
+    and must be re-run).
 
     CAVEAT: v5's ``n_conflicts`` undercounts relative to v1-v4 — twin
     segments deduped wholesale skip the per-node body comparison

@@ -1,0 +1,348 @@
+"""Batched API-level merge waves: many replica pairs, one kernel.
+
+This is the end-to-end north-star path (BASELINE.json config 5: 1024
+divergent replica pairs of 10k-node CausalLists, p50 < 100 ms on one
+chip). The reference converges a fleet by running its O(n*m) pairwise
+reduce-insert once per pair (shared.cljc:300-314); here a wave of
+pairs becomes ONE batched v5 segment-union dispatch whose host side is
+assembly of *cached* per-tree lanes and segment tables (the lane cache,
+weaver/lanecache.py) — no node-dict walking, no Python-per-node work.
+
+Contract (deliberately device-resident, unlike the reference's eager
+materialization): ``merge_wave`` returns a ``WaveResult`` holding
+per-pair rank/visibility lanes and convergence digests. The converged
+*state* is those lanes; turning a pair back into a host ``CausalList``
+(`result.merged(i)`) is on-demand, because rebuilding 1024 Python node
+dicts is host-render cost the wave itself should not pay. Fleet
+control planes that only need convergence checks read the digests.
+
+Pairs outside the accelerated domain (ids beyond the PackSpec, rank
+generations that cannot be aligned, or kernel overflow rows) fall back
+to the ordinary per-pair ``merge`` — same trees out, just slower.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..collections import shared as s
+from ..weaver import lanecache
+from ..weaver.arrays import I32_MAX, next_pow2
+from ..weaver.segments import SEG_LANE_KEYS, concat_segments
+
+__all__ = ["merge_wave", "WaveResult", "WaveBuffers"]
+
+
+@lru_cache(maxsize=8)
+def _digest_fn():
+    from .mesh import replica_digest
+
+    return jax.jit(jax.vmap(replica_digest))
+
+
+class WaveBuffers:
+    """Reusable host-side assembly buffers for repeated waves.
+
+    Allocating ~0.5 GB of [B, 2*cap] batch arrays dominates assembly
+    cost at north-star scale; steady-state sync runs waves over the
+    same fleet shape every round, so the buffers persist and each wave
+    only rewrites the lanes that exist (plus re-padding the shrink gap
+    when a row got shorter). Pass one via ``merge_wave(ctx=...)``."""
+
+    def __init__(self):
+        self.shape = None
+        self.lanes = None
+        self.prev_n = None   # [B, 2] lanes written last wave, per tree
+        self.prev_k = None   # [B] segment-table entries written last wave
+
+    def ensure(self, B: int, cap: int, s_max: int):
+        shape = (B, cap, s_max)
+        N = 2 * cap
+        if self.shape != shape:
+            self.lanes = {
+                "hi": np.full((B, N), I32_MAX, np.int32),
+                "lo": np.full((B, N), I32_MAX, np.int32),
+                "cci": np.full((B, N), -1, np.int32),
+                "vc": np.zeros((B, N), np.int32),
+                "valid": np.zeros((B, N), bool),
+                "seg": np.full((B, N), -1, np.int32),
+                "sg_min_hi": np.zeros((B, s_max), np.int32),
+                "sg_min_lo": np.zeros((B, s_max), np.int32),
+                "sg_max_hi": np.zeros((B, s_max), np.int32),
+                "sg_max_lo": np.zeros((B, s_max), np.int32),
+                "sg_len": np.zeros((B, s_max), np.int32),
+                "sg_lane0": np.zeros((B, s_max), np.int32),
+                "sg_dense": np.zeros((B, s_max), bool),
+                "sg_tail_special": np.zeros((B, s_max), bool),
+                "sg_valid": np.zeros((B, s_max), bool),
+            }
+            self.prev_n = np.zeros((B, 2), np.int64)
+            self.prev_k = np.zeros(B, np.int64)
+            self.shape = shape
+        return self.lanes
+
+
+_PAD = {
+    "hi": I32_MAX, "lo": I32_MAX, "cci": -1, "vc": 0, "valid": False,
+    "seg": -1,
+}
+
+
+def _assemble_rows(views: Sequence[Tuple["lanecache.LaneView",
+                                         "lanecache.LaneView"]],
+                   cap: int, bufs: Optional[WaveBuffers] = None):
+    """[B, 2*cap] v5 lane batch + segment tables from cached views.
+    Pure numpy copies of cached arrays — the per-wave host cost. With
+    ``bufs``, batch arrays are reused across waves and only live lanes
+    (plus any shrink gap vs the previous wave) are rewritten."""
+    B = len(views)
+    per_row_segs = [
+        [(va.segments(), va.n), (vb.segments(), vb.n)]
+        for va, vb in views
+    ]
+    s_max = next_pow2(max(
+        sum(sg["sg_len"].shape[0] for sg, _ in row) for row in per_row_segs
+    ))
+    bufs = bufs or WaveBuffers()
+    lanes = bufs.ensure(B, cap, s_max)
+    hi, lo, cci = lanes["hi"], lanes["lo"], lanes["cci"]
+    vc, valid, seg = lanes["vc"], lanes["valid"], lanes["seg"]
+    # segment-table column map (concat_segments' layout, written
+    # straight into the reused buffers instead of per-row allocations)
+    seg_cols = (
+        ("sg_min_hi", "sg_min_hi"), ("sg_min_lo", "sg_min_lo"),
+        ("sg_max_hi", "sg_max_hi"), ("sg_max_lo", "sg_max_lo"),
+        ("sg_len", "sg_len"), ("sg_dense", "sg_dense"),
+        ("sg_tail_special", "sg_tail_special"),
+    )
+    for r, (va, vb) in enumerate(views):
+        base = 0
+        for t, v in enumerate((va, vb)):
+            v.arena.sync_ranks()
+            a, n = v.arena, v.n
+            off = t * cap
+            sl = slice(off, off + n)
+            hi[r, sl] = a.ts[:n]
+            lo[r, sl] = a.spec.pack_lo(a.site[:n], a.tx[:n])
+            ci = a.cause_idx[:n]
+            cci[r, sl] = np.where(ci >= 0, ci + off, -1)
+            vc[r, sl] = a.vclass[:n]
+            valid[r, sl] = True
+            segs = per_row_segs[r][t][0]
+            k = segs["sg_len"].shape[0]
+            if base + k > s_max:  # cannot happen: s_max covers the max
+                raise OverflowError(f"segment budget {s_max} < {base + k}")
+            tsl = slice(base, base + k)
+            for dst, src in seg_cols:
+                lanes[dst][r, tsl] = segs[src]
+            lanes["sg_lane0"][r, tsl] = segs["sg_head_lane"] + off
+            lanes["sg_valid"][r, tsl] = True
+            seg[r, sl] = segs["run_of_lane"][:n] + base
+            base += k
+            prev = int(bufs.prev_n[r, t])
+            if prev > n:  # re-pad the shrink gap
+                gap = slice(off + n, off + prev)
+                for key, pad in _PAD.items():
+                    lanes[key][r, gap] = pad
+            bufs.prev_n[r, t] = n
+        prev_k = int(bufs.prev_k[r])
+        if prev_k > base:  # invalidate the leftover table tail
+            tgap = slice(base, prev_k)
+            lanes["sg_valid"][r, tgap] = False
+            lanes["sg_len"][r, tgap] = 0
+        bufs.prev_k[r] = base
+    return lanes
+
+
+class WaveResult:
+    """One wave's converged device state plus lazy host materialization.
+
+    - ``digest``: [B] uint32 per-pair weave digests (equal digests =>
+      identical converged linearizations; see mesh.replica_digest) —
+      ONLY where ``digest_valid`` is True. Fallback/overflow rows have
+      no device digest (digest_valid False, value 0); compare their
+      ``merged`` trees instead;
+    - ``rank``/``visible``: [B, 2*cap] per-concat-lane outputs of the
+      v5 kernel (rank == 2*cap for dropped/duplicate/padding lanes);
+    - ``merged(i)``: the converged CausalList of pair i as a host
+      handle — identical to ``pairs[i][0].merge(pairs[i][1])``,
+      including the append-only body validation (conflicting duplicate
+      ids raise CausalError exactly like a merge would);
+    - ``fallback``: indices of pairs that ran the host path instead
+      (outside the device domain or kernel overflow).
+    """
+
+    def __init__(self, pairs, views, cap, rank, visible, digest,
+                 fallback_results, kernel, digest_valid=None):
+        self._pairs = pairs
+        self._views = views
+        self.capacity = cap
+        self.rank = rank
+        self.visible = visible
+        self.digest = digest
+        self.digest_valid = (
+            digest_valid if digest_valid is not None
+            else np.zeros(len(pairs), bool)
+        )
+        self._fallback = fallback_results  # {index: merged_handle}
+        self.kernel = kernel
+
+    @property
+    def fallback(self):
+        return sorted(self._fallback)
+
+    def __len__(self):
+        return len(self._pairs)
+
+    def merged(self, i: int):
+        """Materialize pair ``i``'s converged tree as a host handle."""
+        if i in self._fallback:
+            return self._fallback[i]
+        a, b = self._pairs[i]
+        va, vb = self._views[i]
+        cap = self.capacity
+        rank_row = self.rank[i]
+        keep = np.flatnonzero(rank_row < 2 * cap)
+        order = keep[np.argsort(rank_row[keep], kind="stable")]
+        an, bn = va.arena.nodes, vb.arena.nodes
+
+        def node_at(lane):
+            return an[lane] if lane < cap else bn[lane - cap]
+
+        weave = [node_at(int(j)) for j in order]
+        union = lanecache.union_views(va, vb)
+        nodes = dict(a.ct.nodes)
+        # append-only body validation, C-speed set algebra (the same
+        # check a.merge(b) runs): a duplicate id with a different body
+        # must raise, never yield a weave/nodes-inconsistent tree
+        common = nodes.keys() & b.ct.nodes.keys()
+        for nid in common:
+            if nodes[nid] != b.ct.nodes[nid]:
+                raise s.CausalError(
+                    "This node is already in the tree and can't be "
+                    "changed.",
+                    {"causes": {"append-only", "edits-not-allowed"},
+                     "existing_node": (nid,) + nodes[nid]},
+                )
+        nodes.update(b.ct.nodes)
+        yarns = {}
+        if union is not None:
+            for nd in union.arena.nodes[: union.n]:
+                yarns.setdefault(nd[0][1], []).append(nd)
+        else:  # pragma: no cover - compatible views built by merge_wave
+            for nid in sorted(nodes):
+                yarns.setdefault(nid[1], []).append(
+                    (nid, nodes[nid][0], nodes[nid][1])
+                )
+        lamport = max(a.ct.lamport_ts, b.ct.lamport_ts,
+                      max(nid[0] for nid in nodes))
+        ct = a.ct.evolve(
+            nodes=nodes, yarns=yarns, weave=weave, lamport_ts=lamport,
+            lanes=union,
+        )
+        return type(a)(ct)
+
+
+def merge_wave(pairs: Sequence[Tuple[object, object]],
+               mesh=None, ctx: Optional[WaveBuffers] = None) -> WaveResult:
+    """Merge every (a, b) replica pair in one batched device dispatch.
+
+    All pairs must be list-shaped handles; each pair shares a uuid/type
+    (the usual merge guards). With ``mesh``, the replica axis shards
+    over it (sharded_merge_weave_v5) — the batch must divide the mesh
+    size. Body validation between duplicate ids follows the device
+    contract (jaxw5 module caveat): run ``shared.union_nodes`` or the
+    per-pair ``merge`` path when untrusted replicas are involved.
+    """
+    pairs = list(pairs)
+    if not pairs:
+        raise s.CausalError("Nothing to merge.", {"causes": {"empty-fleet"}})
+    for a, b in pairs:
+        s.check_mergeable(a.ct, b.ct)
+
+    views: List[Optional[Tuple[object, object]]] = []
+    fallback = {}
+    for i, (a, b) in enumerate(pairs):
+        va = lanecache.view_for(a.ct)
+        vb = lanecache.view_for(b.ct)
+        if va is not None and vb is not None and not lanecache.compatible(
+                (va, vb)):
+            # stale rank generation on one side: rebuild both fresh
+            va = lanecache.build_view(a.ct.nodes, a.ct.uuid)
+            vb = lanecache.build_view(b.ct.nodes, b.ct.uuid)
+        if va is None or vb is None or not lanecache.compatible((va, vb)):
+            fallback[i] = a.merge(b)
+            views.append(None)
+        else:
+            views.append((va, vb))
+
+    live = [i for i, v in enumerate(views) if v is not None]
+    if not live:
+        B = len(pairs)
+        return WaveResult(pairs, views, 0,
+                          np.zeros((B, 0), np.int32),
+                          np.zeros((B, 0), bool),
+                          np.zeros(B, np.uint32), fallback, "host")
+
+    cap = next_pow2(max(
+        max(va.n, vb.n) for i in live for va, vb in [views[i]]
+    ))
+    live_views = [views[i] for i in live]
+    if mesh is not None and len(live_views) % mesh.size:
+        # fallbacks shrank the batch below mesh divisibility: pad with
+        # copies of the first live row and drop their outputs below
+        pad_rows = (-len(live_views)) % mesh.size
+        live_views = live_views + [live_views[0]] * pad_rows
+    lanes = _assemble_rows(live_views, cap, bufs=ctx)
+
+    from ..benchgen import LANE_KEYS5, v5_token_budget
+
+    u_max = v5_token_budget(lanes)
+    if mesh is not None:
+        from .mesh import sharded_merge_weave_v5
+
+        jl = {k: jnp.asarray(v) for k, v in lanes.items()}
+        rank, visible, overflow, digest, _tv, _nc, _n_ov = (
+            sharded_merge_weave_v5(mesh, jl, u_max=u_max, k_max=u_max)
+        )
+        rank = np.asarray(rank)
+        visible = np.asarray(visible)
+        digest = np.asarray(digest)
+        overflow = np.asarray(overflow)
+    else:
+        from ..weaver.jaxw5 import batched_merge_weave_v5
+
+        r, v, _c, ov = batched_merge_weave_v5(
+            *(jnp.asarray(lanes[k]) for k in LANE_KEYS5),
+            u_max=u_max, k_max=u_max,
+        )
+        digest = np.asarray(
+            _digest_fn()(jnp.asarray(lanes["hi"]), jnp.asarray(lanes["lo"]),
+                         r, v)
+        )
+        rank, visible = np.asarray(r), np.asarray(v)
+        overflow = np.asarray(ov)
+
+    B = len(pairs)
+    full_rank = np.full((B, 2 * cap), 2 * cap, np.int32)
+    full_vis = np.zeros((B, 2 * cap), bool)
+    full_dig = np.zeros(B, np.uint32)
+    dig_valid = np.zeros(B, bool)
+    for j, i in enumerate(live):
+        if bool(overflow[j]):
+            a, b = pairs[i]
+            fallback[i] = a.merge(b)  # budget blown: host path, correct
+            views[i] = None
+            continue
+        full_rank[i] = rank[j]
+        full_vis[i] = visible[j]
+        full_dig[i] = digest[j]
+        dig_valid[i] = True
+    return WaveResult(pairs, views, cap, full_rank, full_vis, full_dig,
+                      fallback, "v5", dig_valid)

@@ -64,6 +64,8 @@ __all__ = [
     "build_view",
     "extend_view",
     "view_for",
+    "compatible",
+    "union_views",
 ]
 
 
@@ -90,18 +92,17 @@ class SharedInterner:
     end ghost rank stays collision-free.
     """
 
-    __slots__ = ("sites", "rank", "generation", "_lock")
+    __slots__ = ("sites", "rank", "generation", "max_rank", "_lock")
 
     def __init__(self):
         self.sites: List[str] = []
         self.rank: Dict[str, int] = {}
         self.generation = 0
+        self.max_rank = -1  # cached: __len__ sits on the append hot path
         self._lock = threading.Lock()
 
     def __len__(self) -> int:
-        if not self.sites:
-            return 0
-        return max(self.rank[s] for s in self.sites) + 1
+        return self.max_rank + 1
 
     def __contains__(self, site: str) -> bool:
         return site in self.rank
@@ -115,6 +116,7 @@ class SharedInterner:
         step = max(1, _RANK_CEIL // (len(self.sites) + 1))
         self.generation += 1
         self.rank = {s: (i + 1) * step for i, s in enumerate(self.sites)}
+        self.max_rank = len(self.sites) * step
 
     def ensure(self, sites) -> int:
         """Intern any missing sites; returns the (possibly bumped)
@@ -139,6 +141,8 @@ class SharedInterner:
                     self._reassign()  # gap exhausted: spread + new gen
                 else:
                     self.rank[s] = mid
+                    if mid > self.max_rank:
+                        self.max_rank = mid
         return self.generation
 
 
@@ -199,6 +203,47 @@ class LaneArena:
     def capacity(self) -> int:
         return int(self.ts.shape[0])
 
+    def sync_ranks(self) -> None:
+        """Upgrade this arena in place after an interner rank
+        reassignment. Reassignment is order-preserving, so only the
+        site lane and the packed cause-lo lane carry stale VALUES —
+        one vectorized rewrite each brings every view over this arena
+        back into the current generation (no rebuild, no drop). The
+        memoized segment tables embed packed ids, so they clear."""
+        it = self.interner
+        if self.generation == it.generation:
+            return
+        with self.lock:
+            with it._lock:  # consistent (generation, rank) snapshot;
+                # ensure() never takes an arena lock, so no cycle
+                gen = it.generation
+                rank = it.rank
+            if self.generation == gen:
+                return
+            n = self.committed_n
+            self.site[:n] = np.fromiter(
+                (rank[nd[0][1]] for nd in self.nodes[:n]), np.int64, n
+            )
+            has_c = self.cause_idx[:n] >= 0
+            ci = np.clip(self.cause_idx[:n], 0, max(0, n - 1))
+            self.cause_lo[:n] = np.where(
+                has_c,
+                self.spec.pack_lo(self.site[:n][ci], self.tx[:n][ci]),
+                self.cause_lo[:n],
+            )
+            # dangling id causes (no lane to gather from): re-pack off
+            # the host cause tuple — rare, weft-gibberish only
+            dang = (self.cause_hi[:n] >= 0) & ~has_c
+            if dang.any():
+                ghost = len(it)
+                for i in np.flatnonzero(dang):
+                    cz = self.nodes[i][1]
+                    self.cause_lo[i] = self.spec.pack_lo(
+                        np.int32(rank.get(cz[1], ghost)), np.int32(cz[2])
+                    )
+            self.seg_cache.clear()
+            self.generation = gen
+
 
 class LaneView:
     """An immutable (arena, n) snapshot — the ``lanes`` cache slot of
@@ -222,6 +267,7 @@ class LaneView:
         """A ``NodeArrays`` over this view. Lanes at or beyond ``n``
         may hold a newer version's data in the shared arena, so every
         column is masked to the view (cheap vectorized copies)."""
+        self.arena.sync_ranks()
         a, n, cap = self.arena, self.n, self.arena.capacity
         valid = np.zeros(cap, bool)
         valid[:n] = True
@@ -327,8 +373,7 @@ def extend_view(view: Optional[LaneView], new_nodes) -> Optional[LaneView]:
         return None
     arena = view.arena
     interner = arena.interner
-    if interner.generation != arena.generation:
-        return None  # ranks reassigned since this arena was built
+    arena.sync_ranks()  # a rank reassignment upgrades in place
     n = view.n
     tail = arena.nodes[n - 1][0] if n > 0 else None
     prev = tail
@@ -411,3 +456,87 @@ def view_for(ct) -> Optional[LaneView]:
     if isinstance(view, LaneView) and view.n == len(ct.nodes):
         return view
     return build_view(ct.nodes, ct.uuid)
+
+
+def compatible(views) -> bool:
+    """Whether these views' lanes are directly comparable in one kernel
+    invocation: same shared interner object, same rank generation
+    (stale arenas are upgraded in place first — see sync_ranks)."""
+    views = [v for v in views if v is not None]
+    if not views:
+        return False
+    it = views[0].interner
+    for v in views:
+        if v.interner is not it:
+            return False
+        v.arena.sync_ranks()
+    gen = it.generation
+    return all(v.generation == gen for v in views)
+
+
+def _packed_keys(a: LaneArena, n: int) -> np.ndarray:
+    lo = a.spec.pack_lo(a.site[:n], a.tx[:n])
+    return (a.ts[:n].astype(np.int64) << 32) | (
+        lo.astype(np.int64) & 0xFFFFFFFF
+    )
+
+
+def union_views(va: LaneView, vb: LaneView) -> Optional[LaneView]:
+    """Vectorized union of two cached views into a fresh view over the
+    merged node set — the marshal half of an accelerated pair merge
+    with NO per-node Python loop and no dict sort: packed-key argsort
+    of the concatenated lanes, adjacent-duplicate drop, and one
+    searchsorted pass to re-resolve causes against the union. Requires
+    ``compatible`` views (same interner generation, or the packed keys
+    would not be comparable); body conflicts between duplicate ids are
+    NOT checked here — callers run the append-only union validation
+    (shared.union_nodes semantics) before trusting the result."""
+    if not compatible((va, vb)):
+        return None
+    aa, ab = va.arena, vb.arena
+    na_, nb_ = va.n, vb.n
+    keys = np.concatenate([_packed_keys(aa, na_), _packed_keys(ab, nb_)])
+    order = np.argsort(keys, kind="stable")
+    ks = keys[order]
+    dup = np.zeros(len(ks), bool)
+    dup[1:] = ks[1:] == ks[:-1]
+    kept = order[~dup]
+    n = len(kept)
+    cap = next_pow2(n)
+
+    def col(name, fill):
+        src = np.concatenate([
+            getattr(aa, name)[:na_], getattr(ab, name)[:nb_]
+        ])
+        out = np.full(cap, fill, src.dtype)
+        out[:n] = src[kept]
+        return out
+
+    ts = col("ts", 0)
+    site = col("site", 0)
+    tx = col("tx", 0)
+    vclass = col("vclass", 0)
+    cause_hi = col("cause_hi", -1)
+    cause_lo = col("cause_lo", -1)
+    # re-resolve causes against the union's packed keys
+    union_keys = ks[~dup]
+    q = (cause_hi[:n].astype(np.int64) << 32) | (
+        cause_lo[:n].astype(np.int64) & 0xFFFFFFFF
+    )
+    posq = np.searchsorted(union_keys, q)
+    posc = np.clip(posq, 0, max(0, n - 1))
+    found = (cause_hi[:n] >= 0) & (n > 0) & (union_keys[posc] == q)
+    cause_idx = np.full(cap, -1, np.int32)
+    cause_idx[:n] = np.where(found, posc, -1)
+
+    nodes = [
+        (aa.nodes[i] if i < na_ else ab.nodes[i - na_]) for i in kept
+    ]
+    arena = LaneArena(
+        ts=ts, site=site, tx=tx, cause_idx=cause_idx, vclass=vclass,
+        cause_hi=cause_hi, cause_lo=cause_lo, nodes=nodes,
+        lane_of={nid: i for i, (nid, _, _) in enumerate(nodes)},
+        interner=aa.interner, generation=va.generation, spec=aa.spec,
+        committed_n=n,
+    )
+    return LaneView(arena, n)

@@ -1,9 +1,21 @@
-"""API-level merge benchmark: the end-to-end cost of
-``CausalList.merge`` at 10k nodes, per backend, with the jax path
-split into host-marshal vs device-kernel so the marshal overhead is
-measured honestly (kernel-level benchmarks bypass it via benchgen).
+"""API-level merge benchmarks.
 
-Prints one JSON line per backend plus the breakdown.
+Two stories, both end-to-end through the public handles (kernel-level
+benchmarks bypass the host by generating lanes synthetically —
+benchgen; THIS script pays every host cost honestly):
+
+1. default: single ``CausalList.merge`` at 10k nodes per backend, with
+   the jax path split into host-union / host-marshal / device-kernel.
+2. ``--wave B``: a batched merge wave of B divergent replica pairs
+   through ``parallel.merge_wave`` — the north-star path (BASELINE
+   config 5) — split into host assembly (cached-lane gathering +
+   segment tables + budgets) vs device kernel vs digest sync, plus the
+   on-demand cost of materializing one merged pair back to a host
+   handle. The lane cache means assembly touches numpy arrays only;
+   the per-tree marshal was paid once at build time and maintained
+   incrementally by the handles' edits.
+
+Prints one JSON line per measurement.
 """
 
 from __future__ import annotations
@@ -40,10 +52,114 @@ def timed(fn, reps=3):
     return float(np.median(ts))
 
 
+def wave_bench(args):
+    import jax
+
+    import cause_tpu as c
+    from cause_tpu.collections import clist as c_list
+    from cause_tpu.collections.clist import CausalList
+    from cause_tpu.ids import new_site_id
+    from cause_tpu.parallel import merge_wave
+    from cause_tpu.parallel.wave import WaveBuffers, _assemble_rows, _digest_fn
+    from cause_tpu.weaver import lanecache
+    from cause_tpu.weaver.arrays import next_pow2
+    from cause_tpu.benchgen import LANE_KEYS5, v5_token_budget
+    from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+    import jax.numpy as jnp
+
+    B, n_base, n_div = args.wave, args.n_base, args.n_div
+    platform = jax.devices()[0].platform
+
+    t0 = time.perf_counter()
+    base = CausalList(c_list.weave(
+        c.clist(weaver="jax").extend(["x"] * n_base).ct
+    ))
+    pairs = []
+    for p in range(B):
+        a = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+            [f"a{p}.{i}" for i in range(n_div)]
+        )
+        b = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+            [f"b{p}.{i}" for i in range(n_div)]
+        )
+        pairs.append((a, b))
+    build_s = time.perf_counter() - t0
+    print(json.dumps({
+        "metric": "wave setup (mint replicas, incl. incremental lane cache)",
+        "pairs": B, "nodes_per_tree": n_base + n_div + 1,
+        "value": round(build_s, 1), "unit": "s",
+    }), flush=True)
+
+    # --- host side: view gathering + batch assembly + budget ---------
+    bufs = WaveBuffers()
+
+    def host_assemble():
+        views = [(lanecache.view_for(a.ct), lanecache.view_for(b.ct))
+                 for a, b in pairs]
+        cap = next_pow2(max(max(va.n, vb.n) for va, vb in views))
+        lanes = _assemble_rows(views, cap, bufs=bufs)
+        return lanes, v5_token_budget(lanes)
+
+    t_host = timed(host_assemble, reps=args.reps)
+    lanes, u_max = host_assemble()
+
+    # --- device side: one wave dispatch + scalar sync ----------------
+    jlanes = [jnp.asarray(lanes[k]) for k in LANE_KEYS5]
+
+    def kernel_once():
+        r, v, _c_, ov = batched_merge_weave_v5(
+            *jlanes, u_max=u_max, k_max=u_max
+        )
+        d = _digest_fn()(jlanes[0], jlanes[1], r, v)
+        return int(np.asarray(d[0])), int(np.asarray(ov.sum()))
+
+    t_kernel = timed(kernel_once, reps=args.reps)
+
+    # amortized per-wave cost over a pipelined burst (one terminal
+    # sync): the dispatch-floor-resistant number — see PERF.md
+    n_burst = args.burst
+
+    def kernel_burst():
+        outs = []
+        for _ in range(n_burst):
+            r, v, _c_, ov = batched_merge_weave_v5(
+                *jlanes, u_max=u_max, k_max=u_max
+            )
+            outs.append(_digest_fn()(jlanes[0], jlanes[1], r, v))
+        return [int(np.asarray(d[0])) for d in outs][-1]
+
+    t_burst = timed(kernel_burst, reps=max(1, args.reps - 1)) / n_burst
+
+    # --- whole wave through the public API ---------------------------
+    t_wave = timed(lambda: merge_wave(pairs), reps=args.reps)
+    res = merge_wave(pairs)
+    t_mat = timed(lambda: res.merged(0), reps=args.reps)
+
+    _, n_over = kernel_once()
+    print(json.dumps({
+        "metric": f"merge wave {B} pairs x {n_base + n_div + 1}-node "
+                  "CausalLists (API, cached lanes)",
+        "host_assembly_ms": round(t_host, 1),
+        "device_kernel_ms": round(t_kernel, 1),
+        "device_kernel_amortized_ms": round(t_burst, 1),
+        "whole_wave_ms": round(t_wave, 1),
+        "materialize_one_pair_ms": round(t_mat, 2),
+        "host_lt_kernel": bool(t_host < t_kernel),
+        "u_max": int(u_max), "overflow_rows": n_over,
+        "fallback_pairs": len(res.fallback),
+        "platform": platform, "unit": "ms",
+    }), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-base", type=int, default=9_000)
     ap.add_argument("--n-div", type=int, default=1_000)
+    ap.add_argument("--wave", type=int, default=0,
+                    help="batched wave of this many replica pairs")
+    ap.add_argument("--burst", type=int, default=8,
+                    help="pipelined waves per amortized measurement")
+    ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:
@@ -52,8 +168,11 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax
 
-    platform = None
+    if args.wave:
+        wave_bench(args)
+        return
 
+    platform = None
     for weaver in ("pure", "native", "jax"):
         if weaver == "jax":
             platform = jax.devices()[0].platform

@@ -111,7 +111,7 @@ def test_merge_attaches_cache_and_matches():
     assert c.causal_to_edn(m) == got_ref
 
 
-def test_rank_reassignment_invalidates_stale_arenas(monkeypatch):
+def test_rank_reassignment_upgrades_arenas_in_place(monkeypatch):
     monkeypatch.setattr(lanecache, "_RANK_CEIL", 8)
     it = lanecache.SharedInterner()
     g0 = it.ensure(["m"])
@@ -125,21 +125,28 @@ def test_rank_reassignment_invalidates_stale_arenas(monkeypatch):
     ranks = [it.rank[s] for s in sorted(it.rank)]
     assert ranks == sorted(ranks)
 
-    class FakeArena:
-        pass
 
-    view = lanecache.LaneView.__new__(lanecache.LaneView)
-    arena = FakeArena()
-    arena.interner = it
-    arena.generation = g0  # stale stamp
-    arena.nodes = [(
-        (1, "m", 0), None, None
-    )]
-    view.arena = arena
-    view.n = 1
-    assert lanecache.extend_view(
-        view, [((2, "m", 0), (1, "m", 0), "v")]
-    ) is None
+def test_rank_reassignment_does_not_drop_handle_caches(monkeypatch):
+    """Interning thousands of random sites eventually exhausts a gap
+    and reassigns every rank. Handle caches must survive via the
+    in-place arena upgrade (regression: the 1024-pair wave silently
+    rebuilt every view from the node dicts after a reassignment,
+    costing 40+ seconds of host time per wave)."""
+    cl = warm(c.clist(weaver="jax").extend(["x"] * 30))
+    view0 = cl.ct.lanes
+    it = view0.interner
+    g0 = it.generation
+    # force a reassignment on this tree's interner
+    it._reassign()
+    assert it.generation > g0
+    # the cached view still extends (upgraded in place, not dropped)
+    cl2 = cl.conj("after")
+    assert cl2.ct.lanes is not None
+    assert cl2.ct.lanes.n == len(cl2.ct.nodes)
+    assert_view_matches_scratch(cl2.ct)
+    # and weave parity holds
+    ref = c_list.weave(cl2.ct.evolve(weaver="pure")).weave
+    assert c_list.weave(cl2.ct).weave == ref
 
 
 @pytest.mark.slow

@@ -1,0 +1,149 @@
+"""Batched API-level merge waves: device wave == per-pair merge, with
+cached lanes doing the marshal and digests reporting convergence."""
+
+import numpy as np
+import pytest
+
+import cause_tpu as c
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id
+from cause_tpu.parallel import make_mesh, merge_wave
+from cause_tpu.weaver import lanecache
+
+
+def warm(cl):
+    return CausalList(c_list.weave(cl.ct))
+
+
+def make_pairs(n_pairs, n_base=60, n_div=8, weaver="jax"):
+    """Divergent replica pairs of one document, caches warmed."""
+    base = warm(c.clist(weaver=weaver).extend(
+        [f"w{i}" for i in range(n_base)]
+    ))
+    pairs = []
+    for p in range(n_pairs):
+        a = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+            [f"a{p}.{i}" for i in range(n_div)]
+        )
+        b = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+            [f"b{p}.{i}" for i in range(n_div)]
+        )
+        b = b.append(list(b)[-1][0], c.hide)
+        pairs.append((a, b))
+    return pairs
+
+
+def test_wave_matches_pairwise_merge():
+    pairs = make_pairs(6)
+    res = merge_wave(pairs)
+    assert res.kernel == "v5" and not res.fallback
+    for i, (a, b) in enumerate(pairs):
+        got = res.merged(i)
+        ref = a.merge(b)
+        assert c.causal_to_edn(got) == c.causal_to_edn(ref), i
+        assert got.get_nodes() == ref.get_nodes()
+        # the merged handle carries a fresh lane cache for the next wave
+        assert got.ct.lanes is not None
+        assert got.ct.lanes.n == len(got.ct.nodes)
+
+
+def test_wave_digests_detect_divergence_and_convergence():
+    pairs = make_pairs(4)
+    res = merge_wave(pairs)
+    # different pairs diverge -> different digests (w.h.p.)
+    assert len(set(res.digest.tolist())) == len(pairs)
+    # merging the same pair twice converges -> equal digests
+    res2 = merge_wave([pairs[0], pairs[0]])
+    assert res2.digest[0] == res2.digest[1]
+
+
+def test_wave_second_round_reuses_merged_cache():
+    pairs = make_pairs(3)
+    res = merge_wave(pairs)
+    merged = [res.merged(i) for i in range(len(pairs))]
+    # keep editing and wave again: merged handles' caches extend
+    nxt = []
+    for i, m in enumerate(merged):
+        a = CausalList(m.ct.evolve(site_id=new_site_id())).conj(f"x{i}")
+        b = CausalList(m.ct.evolve(site_id=new_site_id())).conj(f"y{i}")
+        assert a.ct.lanes is not None and b.ct.lanes is not None
+        nxt.append((a, b))
+    res2 = merge_wave(nxt)
+    assert not res2.fallback
+    for i, (a, b) in enumerate(nxt):
+        assert c.causal_to_edn(res2.merged(i)) == c.causal_to_edn(a.merge(b))
+
+
+def test_wave_sharded_over_mesh():
+    mesh = make_mesh(8)
+    pairs = make_pairs(8, n_base=40, n_div=4)
+    res = merge_wave(pairs, mesh=mesh)
+    assert not res.fallback
+    for i, (a, b) in enumerate(pairs):
+        assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(a.merge(b))
+
+
+def test_wave_guards_and_fallbacks():
+    pairs = make_pairs(2)
+    # uuid mismatch raises like any merge
+    with pytest.raises(c.CausalError):
+        merge_wave([(pairs[0][0], c.clist("z", weaver="jax"))])
+    # a pure-weaver pair still works (lane cache builds on demand)
+    base = c.clist(weaver="pure").extend(["p"] * 10)
+    a = CausalList(base.ct.evolve(site_id=new_site_id())).conj("1")
+    b = CausalList(base.ct.evolve(site_id=new_site_id())).conj("2")
+    res = merge_wave([(a, b)])
+    assert c.causal_to_edn(res.merged(0)) == c.causal_to_edn(a.merge(b))
+
+
+def test_union_views_equals_scratch_union():
+    from cause_tpu.collections import shared as s
+    from cause_tpu.weaver.arrays import NodeArrays
+
+    pairs = make_pairs(1, n_base=30, n_div=5)
+    a, b = pairs[0]
+    va, vb = lanecache.view_for(a.ct), lanecache.view_for(b.ct)
+    u = lanecache.union_views(va, vb)
+    assert u is not None
+    union_ct = s.union_nodes(a.ct, b.ct)
+    na = NodeArrays.from_nodes_map(union_ct.nodes)
+    assert u.node_arrays().nodes == na.nodes
+    assert np.array_equal(u.node_arrays().cause_idx[: u.n],
+                          na.cause_idx[: na.n])
+
+
+def test_wave_mesh_survives_fallback_shrink():
+    """A pair that falls back must not break mesh divisibility — the
+    live batch pads internally (regression: shard_map requires the
+    replica axis to divide the mesh)."""
+    from cause_tpu.parallel import make_mesh
+
+    mesh = make_mesh(8)
+    pairs = make_pairs(8, n_base=30, n_div=3)
+    # poison one pair with an id beyond the PackSpec ts bound -> its
+    # lane cache can't build and it falls back to the host merge
+    a, b = pairs[3]
+    big = ((1 << 31) - 1, a.get_site_id(), 0)
+    a_bad = a.insert((big, c.root_id, "huge-ts"))
+    b_bad = b.insert((big, c.root_id, "huge-ts"))
+    pairs[3] = (a_bad, b_bad)
+    res = merge_wave(pairs, mesh=mesh)
+    assert res.fallback == [3]
+    assert not res.digest_valid[3] and res.digest_valid[0]
+    for i, (x, y) in enumerate(pairs):
+        assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(x.merge(y))
+
+
+def test_wave_merged_validates_conflicting_bodies():
+    """merged() must raise on conflicting duplicate ids exactly like
+    a.merge(b) — never return a weave/nodes-inconsistent tree."""
+    pairs = make_pairs(1, n_base=20, n_div=2)
+    a, b = pairs[0]
+    evil_id = (500, a.get_site_id(), 0)
+    a2 = a.insert((evil_id, c.root_id, "mine"))
+    b2 = b.insert((evil_id, c.root_id, "theirs"))
+    res = merge_wave([(a2, b2)])
+    with pytest.raises(c.CausalError) as ei:
+        res.merged(0)
+    assert "append-only" in ei.value.info["causes"]
