@@ -1,0 +1,282 @@
+"""Batched/sharded device path for MAP trees: key-rooted forests on
+the list merge kernels.
+
+Map weaves are first-class in the reference (map.cljc:21-45, merge at
+:248-249): every key holds a mini list-weave — key-caused nodes hang
+at the key's root in recency order, id-caused nodes hang under their
+target (undo by id). That IS a forest of list-weave components, so the
+batched device story reuses the list machinery wholesale: encode each
+map tree as lanes over a synthetic id space —
+
+- lane 0: one global root, id ``(-2, 0)`` (sorts below everything;
+  the kernels' "sorted lane 0 is the root" contract);
+- next: one key-root lane per key present in the tree, id
+  ``(-1, key_rank)`` — key ranks interned over the UNION of keys in a
+  batch (same contract as ``SiteInterner`` for sites), so two
+  replicas' roots for one key carry the SAME id and the kernel's
+  duplicate elimination dedupes them exactly like shared base nodes;
+- then the real nodes in ascending id order: key-caused lanes point
+  ``cci`` at their key root, id-caused lanes at their target.
+
+Since real ids are non-negative, synthetic ids can never collide, and
+within each tree the lane order remains ascending-id (the v4 kernel's
+per-tree contract, jaxw4.merge_weave_kernel_v4). The merged per-key
+weave falls out of the kernel's Euler order: each key subtree is
+contiguous, specials-first / descending-id sibling order is exactly
+map recency order, and id-caused chains resolve through the same
+host-jump the list path uses. ``batched_merge_map_weave`` vmaps the
+v4 kernel over replica pairs; the sharded variant rides
+``parallel.mesh.sharded_merge_weave_v4`` unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..ids import is_id
+from .arrays import (
+    DEFAULT_PACK,
+    I32_MAX,
+    OutsideDomain,
+    SiteInterner,
+    next_pow2,
+    vclass_of,
+)
+
+__all__ = [
+    "key_table",
+    "forest_lanes",
+    "pair_rows",
+    "batched_merge_map_weave",
+    "sharded_merge_map_weave",
+    "merged_map_weave",
+    "map_row_digest",
+]
+
+GLOBAL_ROOT_HI = np.int32(-2)
+KEY_ROOT_HI = np.int32(-1)
+
+
+def _key_sort_token(k) -> tuple:
+    """Deterministic, type-stable ordering token for map keys (keys may
+    mix keywords, strings, numbers — Python can't compare those
+    directly)."""
+    return (type(k).__name__, repr(k))
+
+
+def key_table(trees_nodes: Sequence[dict]) -> Dict[object, int]:
+    """Rank every key appearing across the batch (order-preserving
+    over the union — the key twin of SiteInterner's contract)."""
+    keys = set()
+    for nodes_map in trees_nodes:
+        for cause, _v in nodes_map.values():
+            if not is_id(cause) and cause is not None:
+                keys.add(cause)
+    ordered = sorted(keys, key=_key_sort_token)
+    return {k: i for i, k in enumerate(ordered)}
+
+
+def forest_lanes(nodes_map: dict, key_rank: Dict[object, int],
+                 interner: SiteInterner, cap: int,
+                 spec=DEFAULT_PACK):
+    """One map tree as forest lanes padded to ``cap``.
+
+    Returns ``(hi, lo, cci, vc, valid, lane_nodes, lane_keys)`` where
+    ``lane_nodes[i]`` is the host node triple of a real lane (None for
+    synthetic lanes) and ``lane_keys`` the key of each key-root lane.
+    Raises OutsideDomain for shapes the pure weaver defines but the
+    forest encoding doesn't (dangling id causes, id-caused targets that
+    are themselves id-caused — same domain rule as ``map_lanes``).
+    """
+    ids = sorted(nodes_map)
+    present = set()
+    for cause, _v in nodes_map.values():
+        if not is_id(cause):
+            present.add(cause)
+    tree_keys = sorted(present, key=_key_sort_token)
+    n_keys = len(tree_keys)
+    n = 1 + n_keys + len(ids)
+    if n > cap:
+        raise OverflowError(f"capacity {cap} < {n} forest lanes")
+
+    hi = np.full(cap, I32_MAX, np.int32)
+    lo = np.full(cap, I32_MAX, np.int32)
+    cci = np.full(cap, -1, np.int32)
+    vc = np.zeros(cap, np.int32)
+    valid = np.zeros(cap, bool)
+    lane_nodes: List[Optional[tuple]] = [None] * cap
+    lane_keys: List[Optional[object]] = [None] * cap
+
+    hi[0], lo[0] = GLOBAL_ROOT_HI, 0
+    valid[0] = True
+    key_lane = {}
+    for j, k in enumerate(tree_keys):
+        lane = 1 + j
+        hi[lane] = KEY_ROOT_HI
+        lo[lane] = key_rank[k]
+        cci[lane] = 0
+        valid[lane] = True
+        lane_keys[lane] = k
+        key_lane[k] = lane
+
+    idx_of = {nid: 1 + n_keys + i for i, nid in enumerate(ids)}
+    rank = interner.rank
+    for i, nid in enumerate(ids):
+        lane = 1 + n_keys + i
+        cause, value = nodes_map[nid]
+        hi[lane] = nid[0]
+        lo[lane] = spec.pack_lo(np.int32(rank[nid[1]]), np.int32(nid[2]))
+        vc[lane] = vclass_of(value)
+        valid[lane] = True
+        lane_nodes[lane] = (nid, cause, value)
+        if is_id(cause):
+            t = idx_of.get(tuple(cause))
+            if t is None:
+                raise OutsideDomain()  # dangling target
+            target_cause = nodes_map[tuple(cause)][0]
+            if is_id(target_cause):
+                raise OutsideDomain()  # id-caused targeting id-caused
+            cci[lane] = t
+        else:
+            cci[lane] = key_lane[cause]
+    return hi, lo, cci, vc, valid, lane_nodes, lane_keys
+
+
+def pair_rows(pairs: Sequence[Tuple[dict, dict]],
+              spec=DEFAULT_PACK):
+    """[B, 2*cap] forest-lane batch for replica pairs of one map doc.
+
+    Key ranks and site ranks are interned over the whole batch, so
+    every row's synthetic and real ids are mutually comparable and
+    shared keys/nodes dedupe on device. Returns ``(lanes, meta)``:
+    ``lanes`` the dict of [B, 2*cap] arrays (v4 LANE_KEYS4 layout),
+    ``meta`` the per-row host artifacts for ``merged_map_weave``.
+    """
+    trees = [t for pair in pairs for t in pair]
+    krank = key_table(trees)
+    interner = SiteInterner(
+        nid[1] for t in trees for nid in t
+    )
+    cap = next_pow2(max(
+        1 + len(krank) + len(t) for t in trees
+    ))
+    B = len(pairs)
+    N = 2 * cap
+    out = {
+        "hi": np.full((B, N), I32_MAX, np.int32),
+        "lo": np.full((B, N), I32_MAX, np.int32),
+        "cci": np.full((B, N), -1, np.int32),
+        "vc": np.zeros((B, N), np.int32),
+        "valid": np.zeros((B, N), bool),
+    }
+    meta = []
+    for r, (ta, tb) in enumerate(pairs):
+        row_meta = []
+        for t, nodes_map in enumerate((ta, tb)):
+            off = t * cap
+            hi, lo, cci, vc, valid, lane_nodes, lane_keys = forest_lanes(
+                nodes_map, krank, interner, cap, spec
+            )
+            sl = slice(off, off + cap)
+            out["hi"][r, sl] = hi
+            out["lo"][r, sl] = lo
+            out["cci"][r, sl] = np.where(cci >= 0, cci + off, -1)
+            out["vc"][r, sl] = vc
+            out["valid"][r, sl] = valid
+            row_meta.append((lane_nodes, lane_keys))
+        meta.append(row_meta)
+    return out, {"rows": meta, "capacity": cap, "key_rank": krank}
+
+
+def batched_merge_map_weave(lanes: Dict[str, np.ndarray], k_max: int = 0):
+    """Run the batched map-forest merge on device: vmapped v4 kernel
+    over [B, 2*cap] forest lanes. ``k_max`` 0 sizes the run budget at
+    full width (map forests have no chain runs to compress — every
+    key-caused node is a sibling, so the run count ~ lane count).
+    Returns ``(order, rank, visible, conflict, overflow)`` per row."""
+    from .jaxw4 import batched_merge_weave_v4
+
+    if k_max <= 0:
+        k_max = int(lanes["hi"].shape[1])
+    return batched_merge_weave_v4(
+        *(jnp.asarray(lanes[k]) for k in ("hi", "lo", "cci", "vc", "valid")),
+        k_max=k_max,
+    )
+
+
+def sharded_merge_map_weave(mesh, lanes: Dict[str, np.ndarray],
+                            k_max: int = 0):
+    """The sharded twin: map forests ride the v4 sharded step
+    unchanged (parallel.mesh.sharded_merge_weave_v4) — replica axis
+    over the mesh, digests psum'd fleet-wide."""
+    from ..parallel.mesh import sharded_merge_weave_v4
+
+    if k_max <= 0:
+        k_max = int(lanes["hi"].shape[1])
+    return sharded_merge_weave_v4(
+        mesh, jnp.asarray(lanes["hi"]), jnp.asarray(lanes["lo"]),
+        jnp.asarray(lanes["cci"]), jnp.asarray(lanes["vc"]),
+        jnp.asarray(lanes["valid"]), k_max,
+    )
+
+
+def merged_map_weave(lanes, meta, order, rank, row: int):
+    """Rebuild pair ``row``'s merged per-key weave dict from the
+    kernel's order — the map twin of the list paths' rank argsort.
+    Key subtrees are contiguous in Euler order; each key's segment
+    starts at its key-root lane."""
+    from ..ids import ROOT_ID, ROOT_NODE
+
+    cap = meta["capacity"]
+    order_r = np.asarray(order[row])
+    rank_r = np.asarray(rank[row])
+    N = 2 * cap
+    # presort-lane visit order: sorted positions ordered by rank
+    kept = rank_r < N
+    pos = np.flatnonzero(kept)
+    pos = pos[np.argsort(rank_r[pos], kind="stable")]
+    lanes_in_order = order_r[pos]
+    (nodes_a, keys_a), (nodes_b, keys_b) = meta["rows"][row]
+
+    weave: Dict[object, list] = {}
+    current = None
+    for lane in lanes_in_order:
+        lane = int(lane)
+        t, j = divmod(lane, cap)
+        lane_nodes, lane_keys = (nodes_a, keys_a) if t == 0 else (
+            nodes_b, keys_b)
+        if lane_keys[j] is not None:
+            current = lane_keys[j]
+            weave.setdefault(current, [ROOT_NODE])
+            continue
+        nd = lane_nodes[j]
+        if nd is None:
+            continue  # the global root
+        nid, cause, value = nd
+        in_weave_cause = cause if is_id(cause) else ROOT_ID
+        weave[current].append((nid, in_weave_cause, value))
+    return weave
+
+
+def map_row_digest(lanes, rank, visible):
+    """Per-row uint32 digests over the forest lanes (same mix as
+    parallel.mesh.replica_digest, computed host-side on the raw lanes
+    — rank coordinates must match ``rank``'s)."""
+    hi = lanes["hi"].astype(np.uint32)
+    lo = lanes["lo"].astype(np.uint32)
+    rank = np.asarray(rank).astype(np.int64)
+    m = rank.shape[1]
+    keptm = rank < m
+    pos = np.where(keptm, rank, 0).astype(np.uint32)
+    vis = np.asarray(visible).astype(np.uint32)
+    mix = (
+        hi * np.uint32(0x9E3779B1)
+        ^ lo * np.uint32(0x85EBCA77)
+        ^ (pos * np.uint32(2654435761) + vis * np.uint32(40503)
+           + np.uint32(1))
+    )
+    return np.where(keptm, mix, np.uint32(0)).sum(axis=1, dtype=np.uint32)

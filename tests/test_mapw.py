@@ -1,0 +1,129 @@
+"""Batched/sharded map-forest merge: device weave == pure merge, for
+replica pairs of real API-built CausalMaps (VERDICT r2 gap: maps had
+no batched/sharded device path)."""
+
+import random
+
+import numpy as np
+import pytest
+
+import cause_tpu as c
+from cause_tpu import K
+from cause_tpu.collections.cmap import CausalMap
+from cause_tpu.ids import new_site_id
+from cause_tpu.weaver import mapw
+
+
+def fork(cm):
+    return CausalMap(cm.ct.evolve(site_id=new_site_id()))
+
+
+def make_pairs(n_pairs, n_keys=6, edits=4, seed=7):
+    rng = random.Random(seed)
+    base = c.cmap()
+    for i in range(n_keys):
+        base = base.append(K(f"k{i}"), f"v{i}")
+    pairs = []
+    for p in range(n_pairs):
+        a, b = fork(base), fork(base)
+        for e in range(edits):
+            ka = K(f"k{rng.randrange(n_keys + 2)}")
+            a = a.append(ka, f"a{p}.{e}")
+            kb = K(f"k{rng.randrange(n_keys + 2)}")
+            if rng.random() < 0.3:
+                b = b.dissoc(kb)
+            else:
+                b = b.append(kb, f"b{p}.{e}")
+        if rng.random() < 0.5:
+            # id-caused undo of a's last write to ka (map.cljc:33-43)
+            target = a.ct.weave[ka][1][0]
+            a = a.append(target, c.hide)
+        pairs.append((a, b))
+    return pairs
+
+
+def assert_row_matches_pure(pairs, lanes, meta, order, rank, i):
+    a, b = pairs[i]
+    got = mapw.merged_map_weave(lanes, meta, order, rank, i)
+    ref = a.merge(b).ct.weave
+    # device forest visits keys in descending rank order; the weave is
+    # a dict, so compare per key
+    assert set(got) == set(k for k in ref), i
+    for k in ref:
+        assert got[k] == ref[k], (i, k)
+
+
+def test_batched_map_merge_matches_pure():
+    pairs = make_pairs(6)
+    lanes, meta = mapw.pair_rows([(a.ct.nodes, b.ct.nodes)
+                                  for a, b in pairs])
+    order, rank, visible, conflict, overflow = mapw.batched_merge_map_weave(
+        lanes
+    )
+    assert not bool(np.asarray(overflow).any())
+    for i in range(len(pairs)):
+        assert_row_matches_pure(pairs, lanes, meta, order, rank, i)
+
+
+def test_map_digests_detect_convergence():
+    pairs = make_pairs(4)
+    lanes, meta = mapw.pair_rows([(a.ct.nodes, b.ct.nodes)
+                                  for a, b in pairs])
+    order, rank, visible, _c_, _ov = mapw.batched_merge_map_weave(lanes)
+    d = mapw.map_row_digest(lanes, rank, visible)
+    assert len(set(d.tolist())) == len(pairs)  # distinct pairs diverge
+    # identical pair twice -> identical digests
+    two = [pairs[0], pairs[0]]
+    l2, m2 = mapw.pair_rows([(a.ct.nodes, b.ct.nodes) for a, b in two])
+    _o2, r2, v2, _c2, _ov2 = mapw.batched_merge_map_weave(l2)
+    d2 = mapw.map_row_digest(l2, r2, v2)
+    assert d2[0] == d2[1]
+
+
+def test_sharded_map_merge_agrees_with_batched():
+    from cause_tpu.parallel import make_mesh
+
+    pairs = make_pairs(8, n_keys=4, edits=3)
+    lanes, meta = mapw.pair_rows([(a.ct.nodes, b.ct.nodes)
+                                  for a, b in pairs])
+    order, rank, visible, _c_, _ov = mapw.batched_merge_map_weave(lanes)
+    mesh = make_mesh(8)
+    so, sr, sv, sdig, _tv, _nc, n_ov = mapw.sharded_merge_map_weave(
+        mesh, lanes
+    )
+    assert int(n_ov) == 0
+    assert np.array_equal(np.asarray(sr), np.asarray(rank))
+    for i in range(len(pairs)):
+        assert_row_matches_pure(pairs, lanes, meta, np.asarray(so),
+                                np.asarray(sr), i)
+
+
+def test_forest_lanes_domain_guards():
+    from cause_tpu.weaver.arrays import OutsideDomain, SiteInterner
+
+    cm = c.cmap().append(K("a"), 1)
+    krank = mapw.key_table([cm.ct.nodes])
+    interner = SiteInterner(nid[1] for nid in cm.ct.nodes)
+    # well-formed tree marshals
+    mapw.forest_lanes(cm.ct.nodes, krank, interner, 16)
+    # dangling id cause is off-domain
+    bad = dict(cm.ct.nodes)
+    bad[(9, cm.get_site_id(), 0)] = ((5, "nowhere______", 0), "x")
+    with pytest.raises(OutsideDomain):
+        mapw.forest_lanes(bad, krank, interner, 16)
+
+
+@pytest.mark.slow
+def test_map_fuzz_batched_parity():
+    rng = random.Random(11)
+    for round_ in range(6):
+        pairs = make_pairs(
+            5, n_keys=rng.randrange(2, 8), edits=rng.randrange(2, 9),
+            seed=round_,
+        )
+        lanes, meta = mapw.pair_rows([(a.ct.nodes, b.ct.nodes)
+                                      for a, b in pairs])
+        order, rank, _v, _c_, ov = mapw.batched_merge_map_weave(lanes)
+        assert not bool(np.asarray(ov).any())
+        for i in range(len(pairs)):
+            assert_row_matches_pure(pairs, lanes, meta, order, rank, i)
