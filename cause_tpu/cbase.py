@@ -14,11 +14,15 @@ from dataclasses import dataclass, replace
 from typing import Any, Dict, List, Optional, Tuple
 
 from . import util as u
+from .collections import ccounter as c_counter
 from .collections import clist as c_list
 from .collections import cmap as c_map
+from .collections import cset as c_set
 from .collections import shared as s
+from .collections.ccounter import CausalCounter
 from .collections.clist import CausalList
 from .collections.cmap import CausalMap
+from .collections.cset import CausalSet
 from .ids import (
     HIDE,
     H_HIDE,
@@ -170,12 +174,23 @@ def _is_maplike(v) -> bool:
     return isinstance(v, (dict, CausalMap))
 
 
+def _is_setlike(v) -> bool:
+    """Set-shaped values nest as CausalSet collections (beyond the
+    reference, which has no set type — README.md:250 roadmap): Python
+    set/frozenset literals and CausalSet handles."""
+    return isinstance(v, (set, frozenset, CausalSet))
+
+
+def _is_counterlike(v) -> bool:
+    return isinstance(v, CausalCounter)
+
+
 def _is_seqable(v) -> bool:
     """The reference's ``seqable?`` restricted to the value shapes the
     tx engine understands: strings, sequences, sets, and causal
     collections."""
     return isinstance(v, (str, list, tuple, set, frozenset, dict,
-                          CausalList, CausalMap))
+                          CausalList, CausalMap, CausalSet))
 
 
 def _as_map(v) -> dict:
@@ -184,6 +199,10 @@ def _as_map(v) -> dict:
 
 def _as_seq(v):
     return v.causal_to_edn() if isinstance(v, CausalList) else v
+
+
+def _as_set(v):
+    return v.causal_to_edn() if isinstance(v, CausalSet) else v
 
 
 def new_node(cb: CB, tx_index: Optional[int], cause, value):
@@ -217,6 +236,10 @@ def add_collection_of_this_values_type_to_cb(cb: CB, value, is_root: bool = Fals
     ``(cb, uuid_or_None)`` (base/core.cljc:117-126)."""
     if _is_maplike(value):
         causal = c_map.new_causal_map(weaver=cb.weaver)
+    elif _is_setlike(value):
+        causal = c_set.new_causal_set(weaver=cb.weaver)
+    elif _is_counterlike(value):
+        causal = c_counter.new_causal_counter(weaver=cb.weaver)
     elif _is_seqable(value):
         causal = c_list.new_causal_list(weaver=cb.weaver)
     else:
@@ -269,6 +292,60 @@ def list_to_nodes(cb: CB, tx_index: int, list_value, cause=None):
     return cb, tx_index, nodes, cause
 
 
+def _set_member_key(x):
+    """Deterministic sort key for set members across processes: the
+    canonical serde encoding where possible (repr of a frozenset is
+    hash-seed dependent), else a type-tagged repr."""
+    from . import serde  # lazy: serde imports this module
+
+    try:
+        return (0, serde.dumps(x))
+    except Exception:  # noqa: BLE001 - unencodable: best-effort order
+        return (1, type(x).__name__, repr(x))
+
+
+def set_to_nodes(cb: CB, tx_index: int, set_value, cause=None):
+    """Flatten a set-shaped value into cause-chained add-nodes (the
+    shape ``CausalSet.add`` mints). Elements stay whole — no string
+    explosion; a set of chars is a set of strings — and iterate in a
+    deterministic order so replicas flattening equal literals mint
+    comparable structures. Members must render hashable: a member that
+    would flatten to a nested collection Ref (dict/list/frozenset
+    inside a set) is rejected up front — its rendered value could
+    never live in the materialized Python set. Returns
+    ``(cb, tx_index, nodes, last_id)``.
+    """
+    nodes = []
+    cause = cause if cause is not None else ROOT_ID
+    for v in sorted(_as_set(set_value), key=_set_member_key):
+        cb, tx_index, flat_v = flatten_value(cb, tx_index, v,
+                                             preserve_strings=True)
+        if is_ref(flat_v):
+            raise s.CausalError(
+                "set members must be scalar (a nested collection "
+                "cannot render into a set)",
+                {"causes": {"unhashable-set-member"},
+                 "type": type(v).__name__},
+            )
+        tx_index, n = new_node(cb, tx_index, cause, flat_v)
+        nodes.append(n)
+        cause = n[0]
+    return cb, tx_index, nodes, cause
+
+
+def counter_to_nodes(cb: CB, tx_index: int, value, cause=None):
+    """One delta node carrying the counter's current value (a nested
+    CausalCounter enters the base as its materialized sum — the same
+    render-then-rebuild stance the reference takes for nested causal
+    collections, base/core.cljc:130-138)."""
+    delta = value.value() if isinstance(value, CausalCounter) else value
+    cause = cause if cause is not None else ROOT_ID
+    if delta == 0:
+        return cb, tx_index, [], cause
+    tx_index, n = new_node(cb, tx_index, cause, delta)
+    return cb, tx_index, [n], n[0]
+
+
 def flatten_collection(cb: CB, tx_index: int, value, node_fn):
     """Turn a nested collection value into its own collection plus a Ref
     (base/core.cljc:158-164)."""
@@ -281,21 +358,51 @@ def flatten_collection(cb: CB, tx_index: int, value, node_fn):
 
 
 def flatten_value(cb: CB, tx_index: int, value, preserve_strings: bool = False):
-    """Recursively flatten an EDN-like value (base/core.cljc:166-172)."""
+    """Recursively flatten an EDN-like value (base/core.cljc:166-172,
+    extended with the set/counter types the reference only road-maps:
+    set literals and CausalSet handles nest as CausalSet collections,
+    CausalCounter handles as counter collections — all behind Refs,
+    all first-class in history/undo/serde/sync)."""
     if preserve_strings and isinstance(value, str):
         return cb, tx_index, value
     if _is_maplike(value):
         return flatten_collection(cb, tx_index, value, map_to_nodes)
+    if _is_setlike(value):
+        return flatten_collection(cb, tx_index, value, set_to_nodes)
+    if _is_counterlike(value):
+        return flatten_collection(cb, tx_index, value, counter_to_nodes)
     if _is_seqable(value):
         return flatten_collection(cb, tx_index, value, list_to_nodes)
     return cb, tx_index, value
 
 
-def value_to_nodes(cb: CB, tx_index: int, cause, value):
+def value_to_nodes(cb: CB, tx_index: int, cause, value, causal=None):
     """Nodes for a value merged into an existing collection
-    (base/core.cljc:174-182)."""
+    (base/core.cljc:174-182). ``causal`` disambiguates the target type
+    when the value shape alone would pick the wrong flattener (a set
+    literal into a CausalSet must not explode strings per char)."""
     if _is_maplike(value):
         return map_to_nodes(cb, tx_index, value)
+    if isinstance(causal, CausalSet) and (_is_setlike(value)
+                                          or _is_seqable(value)):
+        if isinstance(value, str):
+            members = {value}  # strings are single members, never chars
+        elif _is_setlike(value):
+            members = value
+        else:
+            try:
+                members = set(_as_seq(value))
+            except TypeError:
+                raise s.CausalError(
+                    "set members must be hashable",
+                    {"causes": {"unhashable-set-member"}},
+                ) from None
+        cb, tx_index, nodes, _ = set_to_nodes(cb, tx_index, members, cause)
+        return cb, tx_index, nodes
+    if isinstance(causal, CausalCounter) and _is_counterlike(value):
+        cb, tx_index, nodes, _ = counter_to_nodes(cb, tx_index, value,
+                                                  cause)
+        return cb, tx_index, nodes
     if _is_seqable(value):
         cb, tx_index, nodes, _ = list_to_nodes(cb, tx_index, value, cause)
         return cb, tx_index, nodes
@@ -305,15 +412,19 @@ def value_to_nodes(cb: CB, tx_index: int, cause, value):
 
 def merge_value_into_parent_collection(cb: CB, uuid, cause, value) -> bool:
     """Should the value's members merge directly into the addressed
-    collection rather than nest (base/core.cljc:184-190)?"""
+    collection rather than nest (base/core.cljc:184-190)? Sets accept
+    set-shaped/sequence members; counters accept scalar deltas through
+    the plain-node path below instead."""
     causal = cb.collections.get(uuid)
     if cause is None and _is_maplike(value) and isinstance(causal, CausalMap):
         return True
     if (
         not _is_maplike(value)
-        and _is_seqable(value)
-        and isinstance(causal, CausalList)
+        and (_is_seqable(value) or _is_setlike(value))
+        and isinstance(causal, (CausalList, CausalSet))
     ):
+        return True
+    if _is_counterlike(value) and isinstance(causal, CausalCounter):
         return True
     return False
 
@@ -322,8 +433,17 @@ def handle_tx_part_value(cb: CB, tx_part, tx_index: int):
     """(base/core.cljc:192-201)"""
     uuid, cause, value = tx_part
     causal = cb.collections.get(uuid)
+    if isinstance(causal, CausalSet) and _is_maplike(value):
+        # a nested-collection Ref could never render inside the
+        # materialized Python set — reject at transact, not at render
+        raise s.CausalError(
+            "set members must be scalar (a nested collection cannot "
+            "render into a set)",
+            {"causes": {"unhashable-set-member"}},
+        )
     if merge_value_into_parent_collection(cb, uuid, cause, value):
-        cb, tx_index, nodes = value_to_nodes(cb, tx_index, cause, value)
+        cb, tx_index, nodes = value_to_nodes(cb, tx_index, cause, value,
+                                             causal)
         if nodes:
             cb = insert(cb, uuid, nodes)
         return cb, tx_index
@@ -360,7 +480,8 @@ def validate_tx_part(cb: CB, tx_part) -> None:
         )
     if uuid is None and not isinstance(value, (dict, list, tuple, set,
                                                frozenset, CausalList,
-                                               CausalMap)):
+                                               CausalMap, CausalSet,
+                                               CausalCounter)):
         raise s.CausalError(
             "Root node must satisfy the coll? predicate", {"value": value}
         )

@@ -158,3 +158,107 @@ def test_counter_serde_round_trip():
     assert isinstance(back, CausalCounter)
     assert back.value() == 5
     assert back.merge(fork(cc, CausalCounter).increment(1)).value() == 6
+
+
+def test_set_and_counter_first_class_in_base():
+    """The VERDICT r2 'Done' flow: a base transaction containing a set
+    and a counter, edited, undone, redone, serde round-tripped, and
+    synced via sync_base_pair — sets/counters are full citizens of the
+    base (nesting, refs, history), not opaque values."""
+    from cause_tpu import cbase as b
+    from cause_tpu import serde, sync
+    from cause_tpu.collections.ccounter import CausalCounter
+    from cause_tpu.collections.cset import CausalSet
+    from cause_tpu.ids import K
+
+    votes = c.ccounter(3)
+    cb = b.transact_(b.new_cb(), [[None, None, {
+        K("tags"): {"a", "b"},
+        K("votes"): votes,
+        K("title"): "doc",
+    }]])
+    edn = b.cb_to_edn(cb)
+    assert edn[K("tags")] == {"a", "b"}
+    assert edn[K("votes")] == 3
+    assert edn[K("title")] == "doc"
+    # the nested collections are real typed handles behind refs
+    kinds = {type(h).__name__ for h in cb.collections.values()}
+    assert {"CausalSet", "CausalCounter", "CausalMap"} <= kinds
+
+    # write INTO them through the base (members merge, not nest)
+    set_uuid = next(u_ for u_, h in cb.collections.items()
+                    if isinstance(h, CausalSet))
+    ctr_uuid = next(u_ for u_, h in cb.collections.items()
+                    if isinstance(h, CausalCounter))
+    cb2 = b.transact_(cb, [
+        [set_uuid, None, {"c"}],
+        [ctr_uuid, c.root_id, 4],
+    ])
+    edn2 = b.cb_to_edn(cb2)
+    assert edn2[K("tags")] == {"a", "b", "c"}
+    assert edn2[K("votes")] == 7
+
+    # undo walks history back through the set/counter writes
+    cb3 = b.undo_(cb2)
+    assert b.cb_to_edn(cb3)[K("tags")] == {"a", "b"}
+    assert b.cb_to_edn(cb3)[K("votes")] == 3
+    cb4 = b.redo_(cb3)
+    assert b.cb_to_edn(cb4)[K("tags")] == {"a", "b", "c"}
+    assert b.cb_to_edn(cb4)[K("votes")] == 7
+
+    # serde round-trips the nested instances with their types
+    blob = serde.dumps(b.CausalBase(cb4))
+    back = serde.loads(blob)
+    assert b.cb_to_edn(back.cb) == b.cb_to_edn(cb4)
+    kinds2 = {type(h).__name__ for h in back.cb.collections.values()}
+    assert {"CausalSet", "CausalCounter"} <= kinds2
+
+    # sync two replicas of the base (divergent set + counter edits)
+    ra = b.CausalBase(cb4.evolve(site_id="siteA________"))
+    rb = b.CausalBase(cb4.evolve(site_id="siteB________"))
+    ra = b.CausalBase(b.transact_(ra.cb, [[set_uuid, None, {"x"}]]))
+    rb = b.CausalBase(b.transact_(rb.cb, [[ctr_uuid, c.root_id, -2]]))
+    sa, sb = sync.sync_base_pair(ra, rb)
+    ea, eb = b.cb_to_edn(sa.cb), b.cb_to_edn(sb.cb)
+    assert ea == eb
+    assert ea[K("tags")] == {"a", "b", "c", "x"}
+    assert ea[K("votes")] == 5
+
+
+def test_base_set_counter_edge_cases():
+    """Review-found edges: root-level counters keep their value; set
+    writes reject anything that cannot render into a Python set at
+    TRANSACT time (never poisoning later renders); strings stay whole."""
+    from cause_tpu import cbase as b
+    from cause_tpu.collections.ccounter import CausalCounter
+    from cause_tpu.collections.cset import CausalSet
+    from cause_tpu.ids import K
+
+    # root-level counter: value preserved, exactly one collection
+    cb = b.transact_(b.new_cb(), [[None, None, c.ccounter(5)]])
+    assert b.cb_to_edn(cb) == 5
+    assert sum(isinstance(h, CausalCounter)
+               for h in cb.collections.values()) == 1
+
+    cb2 = b.transact_(b.new_cb(), [[None, None, {K("tags"): {"a"}}]])
+    set_uuid = next(u for u, h in cb2.collections.items()
+                    if isinstance(h, CausalSet))
+
+    # a dict into a set is rejected at transact, not at render
+    with pytest.raises(c.CausalError) as ei:
+        b.transact_(cb2, [[set_uuid, None, {"k": 1}]])
+    assert "unhashable-set-member" in ei.value.info["causes"]
+
+    # unhashable sequence members reject as CausalError, not TypeError
+    with pytest.raises(c.CausalError):
+        b.transact_(cb2, [[set_uuid, None, [[1, 2], [3]]]])
+
+    # frozenset members would flatten to nested-collection refs: reject
+    with pytest.raises(c.CausalError):
+        b.transact_(cb2, [[set_uuid, None, {frozenset({1, 2})}]])
+
+    # a bare string is ONE member, never exploded to chars
+    cb3 = b.transact_(cb2, [[set_uuid, None, "abc"]])
+    assert b.cb_to_edn(cb3)[K("tags")] == {"a", "abc"}
+    # and the base still renders fine afterwards
+    assert b.cb_to_edn(b.undo_(cb3))[K("tags")] == {"a"}
