@@ -57,11 +57,11 @@ LANE_KEYS = ("hi", "lo", "chi", "clo", "vc", "valid")
 # index in the concatenated pre-sort lane array (known at marshal time)
 LANE_KEYS4 = ("hi", "lo", "cci", "vc", "valid")
 # the v5 segment-union kernel: v4's node lanes + per-lane segment ids
-# + the marshal-extracted segment tables (segments.SEG_LANE_KEYS)
-LANE_KEYS5 = LANE_KEYS4 + (
-    "seg", "sg_min_hi", "sg_min_lo", "sg_max_hi", "sg_max_lo",
-    "sg_len", "sg_lane0", "sg_dense", "sg_tail_special", "sg_valid",
-)
+# + the marshal-extracted segment tables (derived from
+# segments.SEG_LANE_KEYS so the two can never drift)
+from .weaver.segments import SEG_LANE_KEYS as _SEG_LANE_KEYS
+
+LANE_KEYS5 = LANE_KEYS4 + ("seg",) + _SEG_LANE_KEYS
 
 def _union_lanes_np(hi, lo, chi, clo, vc, valid):
     """Numpy twin of the merge kernel's front half (id lexsort, dup
@@ -329,6 +329,7 @@ def estimate_tokens(v5row: Dict[str, np.ndarray]) -> int:
     ln = v5row["sg_len"][va]
     dense = v5row["sg_dense"][va]
     tsp = v5row["sg_tail_special"][va]
+    vsum = v5row["sg_vsum"][va]
     lane0 = v5row["sg_lane0"][va]
     S = ln.shape[0]
     if S == 0:
@@ -339,6 +340,7 @@ def estimate_tokens(v5row: Dict[str, np.ndarray]) -> int:
     mins, maxs = mins[order], maxs[order]
     ln, dense, tsp, lane0 = (ln[order], dense[order], tsp[order],
                              lane0[order])
+    vsum = vsum[order]
     ncap = len(v5row["cci"])
     hvc = v5row["vc"][np.clip(lane0, 0, ncap - 1)]
     cl0 = v5row["cci"][np.clip(lane0, 0, ncap - 1)]
@@ -352,7 +354,8 @@ def estimate_tokens(v5row: Dict[str, np.ndarray]) -> int:
     same = np.zeros(S, bool)
     same[1:] = ((mins[1:] == mins[:-1]) & (maxs[1:] == maxs[:-1])
                 & (ln[1:] == ln[:-1]) & dense[1:] & dense[:-1]
-                & (hvc[1:] == hvc[:-1]) & (cid0[1:] == cid0[:-1]))
+                & (hvc[1:] == hvc[:-1]) & (cid0[1:] == cid0[:-1])
+                & (tsp[1:] == tsp[:-1]) & (vsum[1:] == vsum[:-1]))
     grp = np.cumsum(~same) - 1
     g_min = mins[np.concatenate([[True], ~same[1:]])]
     g_max = maxs[np.concatenate([[True], ~same[1:]])]

@@ -211,7 +211,7 @@ def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int):
     @partial(
         _shard_map,
         mesh=mesh,
-        in_specs=(sharded,) * 15,
+        in_specs=(sharded,) * 16,
         out_specs=(sharded, sharded, sharded, sharded, replicated,
                    replicated, replicated),
     )
@@ -240,13 +240,13 @@ def sharded_merge_weave_v5(mesh: Mesh, lanes: dict, u_max: int,
     array in the v5 contract; ``overflow`` rows carry garbage ranks
     and must be re-run).
 
-    CAVEAT: v5's ``n_conflicts`` undercounts relative to v1-v4 — twin
-    segments deduped wholesale skip the per-node body comparison
-    (jaxw5 module docstring), so a divergent *interior* body inside an
-    otherwise-identical dense segment goes unreported here. Fleet
-    control planes that alert on conflicts should validate bodies
-    host-side (shared.union_nodes does) or use a v1/v4 pass for
-    auditing."""
+    CAVEAT (narrowed in round 3 by the sg_vsum checksum lane): twin
+    dedupe now verifies member value CLASSES and structure, so
+    class-divergent corrupt twins explode and count in
+    ``n_conflicts``; what remains device-invisible is host VALUE bytes
+    (identical ids/classes/causes, different payload). Fleet control
+    planes that must catch those validate bodies host-side
+    (shared.union_nodes does)."""
     from ..benchgen import LANE_KEYS5
 
     step = _sharded_step_v5(mesh, u_max, k_max)

@@ -81,6 +81,7 @@ class WaveBuffers:
                 "sg_dense": np.zeros((B, s_max), bool),
                 "sg_tail_special": np.zeros((B, s_max), bool),
                 "sg_valid": np.zeros((B, s_max), bool),
+                "sg_vsum": np.zeros((B, s_max), np.int32),
             }
             self.prev_n = np.zeros((B, 2), np.int64)
             self.prev_k = np.zeros(B, np.int64)
@@ -119,7 +120,7 @@ def _assemble_rows(views: Sequence[Tuple["lanecache.LaneView",
         ("sg_min_hi", "sg_min_hi"), ("sg_min_lo", "sg_min_lo"),
         ("sg_max_hi", "sg_max_hi"), ("sg_max_lo", "sg_max_lo"),
         ("sg_len", "sg_len"), ("sg_dense", "sg_dense"),
-        ("sg_tail_special", "sg_tail_special"),
+        ("sg_tail_special", "sg_tail_special"), ("sg_vsum", "sg_vsum"),
     )
     for r, (va, vb) in enumerate(views):
         base = 0

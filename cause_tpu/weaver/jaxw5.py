@@ -34,10 +34,16 @@ and v1 (tests/test_jax_v5.py); like v2-v4 the kernel takes static
 budgets (``s`` is the table size, ``u_max`` tokens, ``k_max`` runs)
 and raises an overflow flag instead of corrupting.
 
-Caveat (documented divergence from v4's diagnostics, not semantics):
-wholesale-deduped twin segments skip the per-node body comparison, so
-the ``conflict`` flag only covers exploded/duplicated tokens — the
-API paths validate bodies host-side anyway (shared.union_nodes).
+Twin-dedupe integrity: the twin test compares endpoints, length,
+density, head vclass + cause, tail-specialness, AND a position
+-weighted vclass checksum (``sg_vsum``), so a same-id twin whose
+interior value CLASSES or structure diverge (append-only violation
+from a corrupt replica) explodes and trips the node-level ``conflict``
+check instead of vanishing wholesale. What the device still cannot
+see is host VALUE bytes — two twins identical in ids/classes/causes
+but differing in, say, the string payload of one node pass the device
+unflagged; the API paths validate bodies host-side
+(shared.union_nodes, WaveResult.merged) for exactly that reason.
 """
 
 from __future__ import annotations
@@ -107,7 +113,7 @@ def _pair_search_le(kh, kl, qh, ql, size):
 def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
                           sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
                           sg_len, sg_lane0, sg_dense, sg_tail_special,
-                          sg_valid, u_max: int, k_max: int):
+                          sg_valid, sg_vsum, u_max: int, k_max: int):
     """Union + reweave at segment granularity for one replica set.
 
     Node lanes as in v4 (``hi/lo/cci/vclass/valid`` — trees
@@ -132,6 +138,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     s_lane0 = sg_lane0[s_src]
     s_dense = sg_dense[s_src]
     s_tsp = sg_tail_special[s_src]
+    s_vsum = sg_vsum[s_src]
     s_va = sg_valid[s_src]
 
     # head body fields (shared by the twin test and the E2 stabs)
@@ -143,10 +150,13 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
 
     # twin groups: adjacent exact-equal dense segments dedupe wholesale.
     # Equality covers the endpoints, length, density, the head's value
-    # class, and the head's cause id — a same-id segment with a
-    # different head body fails the test, overlaps, explodes, and the
-    # node-level duplicate check reports the conflict. (Interior bodies
-    # of multi-lane twins stay uncompared — see the module caveat.)
+    # class and cause id, the tail-special flag, and the position
+    # -weighted vclass checksum (sg_vsum) — so a same-id segment whose
+    # INTERIOR body classes differ (a corrupt replica violating
+    # append-only) fails the test, explodes, and the node-level
+    # duplicate check reports the conflict. Host VALUES remain a
+    # host-side check (shared.union_nodes / WaveResult.merged): the
+    # device never sees them.
     p_mh, p_ml = _shift1(s_mh, -1), _shift1(s_ml, -1)
     same_prev = (
         _eq(s_mh, s_ml, p_mh, p_ml)
@@ -154,6 +164,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
         & (s_len == _shift1(s_len, -1))
         & s_dense & _shift1(s_dense, False)
         & (s_hvc == _shift1(s_hvc, -1))
+        & (s_tsp == _shift1(s_tsp, False))
+        & (s_vsum == _shift1(s_vsum, -1))
         & _eq(c_hi, c_lo, _shift1(c_hi, -1), _shift1(c_lo, -1))
         & s_va & _shift1(s_va, False)
         & (sidx > 0)
@@ -522,7 +534,7 @@ merge_weave_kernel_v5_jit = jax.jit(
 def batched_merge_weave_v5(hi, lo, cci, vclass, valid, seg,
                            sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
                            sg_len, sg_lane0, sg_dense, sg_tail_special,
-                           sg_valid, u_max: int, k_max: int):
+                           sg_valid, sg_vsum, u_max: int, k_max: int):
     """Segment-union batch: [B, N] node lanes + [B, S] segment tables
     -> per-replica (rank, visible, conflict, overflow), rank/visible
     indexed by concat lane."""
@@ -533,4 +545,4 @@ def batched_merge_weave_v5(hi, lo, cci, vclass, valid, seg,
     return jax.vmap(row)(hi, lo, cci, vclass, valid, seg,
                          sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
                          sg_len, sg_lane0, sg_dense, sg_tail_special,
-                         sg_valid)
+                         sg_valid, sg_vsum)

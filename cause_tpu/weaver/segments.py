@@ -49,12 +49,20 @@ SEG_KEYS = (
                      # (hi..hi+len-1, constant lo) conj chains or
                      # (constant hi, lo..lo+len-1) tx runs; dedupe ok
     "sg_tail_special",  # tail lane carries a special (tombstone suffix)
+    "sg_vsum",       # position-weighted vclass checksum of the members:
+                     # sum((i+1) * vclass). Twin dedupe compares it so a
+                     # same-id segment whose INTERIOR body classes differ
+                     # (append-only violation from a corrupt replica)
+                     # explodes and hits the node-level conflict check
+                     # instead of vanishing wholesale. Host VALUES stay a
+                     # host-side check — the device never sees them.
 )
 
 # the device kernel's segment-table lanes (concat coordinates, padded)
 SEG_LANE_KEYS = (
     "sg_min_hi", "sg_min_lo", "sg_max_hi", "sg_max_lo",
     "sg_len", "sg_lane0", "sg_dense", "sg_tail_special", "sg_valid",
+    "sg_vsum",
 )
 
 
@@ -161,6 +169,17 @@ def tree_segments(hi, lo, cause_idx, vclass, n: int) -> Dict[str, np.ndarray]:
 
     sg_tail_special = special[tail_lane]
 
+    # position-weighted vclass checksum per run: catches interior body
+    # -class divergence between same-id twins (see SEG_KEYS). int64
+    # accumulate + 31-bit mask: bincount's float64 path would make the
+    # int32 cast platform-dependent for very long special runs, and the
+    # checksum only needs deterministic equality
+    offset = idx - head_lane[rid[:n]]
+    vsum64 = np.zeros(n_runs, np.int64)
+    np.add.at(vsum64, rid[:n],
+              (offset.astype(np.int64) + 1) * vclass[:n])
+    sg_vsum = (vsum64 & 0x7FFFFFFF).astype(np.int32)
+
     return {
         "run_of_lane": run_of_lane,
         "sg_head_lane": head_lane,
@@ -171,6 +190,7 @@ def tree_segments(hi, lo, cause_idx, vclass, n: int) -> Dict[str, np.ndarray]:
         "sg_max_lo": sg_max_lo,
         "sg_dense": sg_dense.astype(bool),
         "sg_tail_special": sg_tail_special.astype(bool),
+        "sg_vsum": sg_vsum,
     }
 
 
@@ -195,6 +215,7 @@ def concat_segments(per_tree, capacity: int, s_max: int) -> Dict[str, np.ndarray
         "sg_dense": np.zeros(s_max, bool),
         "sg_tail_special": np.zeros(s_max, bool),
         "sg_valid": np.zeros(s_max, bool),
+        "sg_vsum": np.zeros(s_max, np.int32),
     }
     seg = np.full(n_trees * capacity, -1, np.int32)
     base = 0
@@ -213,6 +234,7 @@ def concat_segments(per_tree, capacity: int, s_max: int) -> Dict[str, np.ndarray
         out["sg_lane0"][sl] = segs["sg_head_lane"] + t * capacity
         out["sg_dense"][sl] = segs["sg_dense"]
         out["sg_tail_special"][sl] = segs["sg_tail_special"]
+        out["sg_vsum"][sl] = segs["sg_vsum"]
         out["sg_valid"][sl] = True
         rl = segs["run_of_lane"]
         lane_sl = slice(t * capacity, t * capacity + n)

@@ -1,0 +1,98 @@
+"""Corrupted-twin detection (VERDICT r2 Weak #4): a same-id dense
+segment whose interior/tail value classes were tampered with must NOT
+dedupe wholesale — it explodes and the node-level duplicate check
+reports the conflict. Before the sg_vsum/tail-special checksum the v5
+kernel silently deduped these."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS5
+from cause_tpu.weaver.arrays import VCLASS_HIDE
+from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5_jit
+
+
+CAP = 128
+
+
+def run_v5(row):
+    u = benchgen.v5_token_budget(row)
+    r, v, conflict, ov = merge_weave_kernel_v5_jit(
+        *(jnp.asarray(row[k]) for k in LANE_KEYS5), u_max=u, k_max=u
+    )
+    return (np.asarray(r), np.asarray(v), bool(conflict), bool(ov))
+
+
+def corrupt_tail(row, capacity):
+    """Flip tree B's copy of the shared base chain's TAIL node to a
+    hide — an append-only violation that preserves B's segmentation
+    (a trailing special still glues), so before the checksum the twin
+    test saw identical endpoints/len/density and deduped it away."""
+    out = {k: row[k].copy() for k in ("hi", "lo", "cci", "vc", "valid")}
+    b0 = capacity  # tree B's block
+    # the shared base occupies the same lane offsets in both blocks;
+    # find the last lane of A's base chain by matching ids
+    n_a = int(out["valid"][:capacity].sum())
+    n_b = int(out["valid"][b0:].sum())
+    # shared prefix length = number of identical (hi, lo) pairs
+    shared = 0
+    while (shared < min(n_a, n_b)
+           and out["hi"][shared] == out["hi"][b0 + shared]
+           and out["lo"][shared] == out["lo"][b0 + shared]):
+        shared += 1
+    assert shared > 2, "fixture must share a base prefix"
+    victim = b0 + shared - 1  # tail of B's copy of the shared chain
+    assert out["vc"][victim] == 0
+    out["vc"][victim] = VCLASS_HIDE
+    return out
+
+
+def test_corrupted_twin_tail_is_detected():
+    row = benchgen.divergent_pair_lanes(
+        n_base=40, n_div=8, capacity=CAP, hide_every=0
+    )
+    clean = benchgen.v5_inputs(
+        {k: row[k] for k in ("hi", "lo", "cci", "vc", "valid")}, CAP
+    )
+    r0, v0, c0, o0 = run_v5(clean)
+    assert not c0 and not o0
+
+    bad = corrupt_tail(row, CAP)
+    badrow = benchgen.v5_inputs(bad, CAP)
+    r1, v1, c1, o1 = run_v5(badrow)
+    assert not o1
+    assert c1, (
+        "a same-id twin with a tampered tail class must flag conflict"
+    )
+
+
+def test_corrupted_twin_interior_is_detected():
+    """Interior corruption changes B's segmentation (the run splits at
+    the special), so endpoints/len no longer match — but the checksum
+    keeps this true even for corruptions that preserve structure."""
+    row = benchgen.divergent_pair_lanes(
+        n_base=40, n_div=8, capacity=CAP, hide_every=0
+    )
+    bad = {k: row[k].copy() for k in ("hi", "lo", "cci", "vc", "valid")}
+    bad["vc"][CAP + 10] = VCLASS_HIDE  # interior of B's base copy
+    badrow = benchgen.v5_inputs(bad, CAP)
+    _r, _v, c1, o1 = run_v5(badrow)
+    assert c1 and not o1
+
+
+def test_clean_twins_still_dedupe():
+    """The checksum must not break wholesale dedupe of HONEST twins:
+    token count stays at segment scale, not node scale."""
+    row = benchgen.divergent_pair_lanes(
+        n_base=400, n_div=10, capacity=1024, hide_every=0
+    )
+    v5row = benchgen.v5_inputs(
+        {k: row[k] for k in ("hi", "lo", "cci", "vc", "valid")}, 1024
+    )
+    toks = benchgen.estimate_tokens(v5row)
+    assert toks < 100, f"dedupe regressed: {toks} tokens for 820 lanes"
+    r, v, c, o = run_v5(v5row)
+    assert not c and not o
