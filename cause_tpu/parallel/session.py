@@ -1,0 +1,316 @@
+"""Device-resident fleet sessions: merge waves without re-shipping the
+fleet.
+
+``merge_wave`` assembles and uploads the full [B, 2*cap] lane batch on
+every call — fine on-package, but the axon-tunneled TPU pays the full
+host->device transfer (hundreds of MB per wave at north-star scale)
+every time. A ``FleetSession`` keeps the batch ON DEVICE between waves
+and ships only what changed:
+
+- per edited tree, the appended delta lanes (the lane cache knows the
+  previous wave's length; appends are the steady state) — a
+  [B, 2, d_max] upload of a few KB;
+- the per-row segment tables (always small: tens of entries per row),
+  re-sent wholesale each wave;
+- a jitted scatter program splices the deltas into the resident lanes
+  (per-row dynamic offsets via masked index scatter — static shapes,
+  no recompiles while d_max stays inside the session's budget).
+
+A tree whose cache dropped (mid-order insert, weft) or whose delta
+exceeds the budget falls back to a full re-upload of the whole batch
+that wave — correct, just slower. ``wave()`` then runs the v5 kernel
+over the resident lanes and fetches ONE small digest array; ranks and
+visibility stay device-resident for on-demand materialization.
+
+This is the TPU-native sync-fleet loop: edit replicas on host, ship
+deltas, converge on device, read digests.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..collections import shared as s
+from ..weaver import lanecache
+from ..weaver.arrays import next_pow2
+from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
+from .wave import WaveBuffers, _PAD, _assemble_rows, _digest_fn
+
+__all__ = ["FleetSession"]
+
+_LANE_COLS = ("hi", "lo", "cci", "vc", "valid", "seg")
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _apply_deltas(dev: Dict[str, jnp.ndarray], deltas: Dict[str, jnp.ndarray],
+                  starts, counts, b_shift, old_nb):
+    """Splice per-tree delta lanes into the resident batch.
+
+    ``deltas[col]`` is [B, 2, d_max]; ``starts``/``counts`` [B, 2] are
+    each tree's previous length and delta size (concat-lane start =
+    tree_offset + start). ``b_shift`` [B] re-bases tree B's OLD seg
+    ordinals when tree A gained segments; ``old_nb`` [B] bounds that
+    shift to B's pre-delta lanes. Buffer-donated: the resident arrays
+    update in place on device.
+    """
+    B, N = dev["hi"].shape
+    cap = N // 2
+    d_max = deltas["hi"].shape[2]
+    off = jnp.arange(d_max, dtype=jnp.int32)
+
+    lane_idx = jnp.arange(N, dtype=jnp.int32)
+    shift_mask = (
+        (lane_idx[None, :] >= cap)
+        & (lane_idx[None, :] < cap + old_nb[:, None])
+        & (dev["seg"] >= 0)
+    )
+    out = dict(dev)
+    out["seg"] = jnp.where(shift_mask, dev["seg"] + b_shift[:, None],
+                           dev["seg"])
+
+    def one_col(col, arr):
+        def row(row_arr, st, ct, d):
+            # masked index scatter: lanes beyond the count drop
+            for t in range(2):
+                idx = t * cap + st[t] + off
+                idx = jnp.where(off < ct[t], idx, N)
+                row_arr = row_arr.at[idx].set(d[t], mode="drop")
+            return row_arr
+
+        return jax.vmap(row)(arr, starts, counts, deltas[col])
+
+    for col in _LANE_COLS:
+        out[col] = one_col(col, out[col])
+    return out
+
+
+class FleetSession:
+    """A device-resident batch of replica pairs converged wave after
+    wave. See the module docstring; usage::
+
+        sess = FleetSession(pairs)          # full upload once
+        d0 = sess.wave()                    # digests, device-resident
+        pairs = edit(pairs)                 # host-side appends
+        sess.update(pairs)                  # ship deltas only
+        d1 = sess.wave()
+    """
+
+    def __init__(self, pairs: Sequence[Tuple[object, object]],
+                 d_max: int = 256, u_headroom: float = 2.0):
+        pairs = list(pairs)
+        if not pairs:
+            raise s.CausalError("Nothing to merge.",
+                                {"causes": {"empty-fleet"}})
+        for a, b in pairs:
+            s.check_mergeable(a.ct, b.ct)
+        self.d_max = int(d_max)
+        self._bufs = WaveBuffers()
+        self._views: List[Tuple[object, object]] = []
+        self._uploaded_n = None     # [B, 2] lane counts on device
+        self._uploaded_k = None     # [B] tree-A segment counts on device
+        self.capacity = 0
+        self.u_max = 0
+        self._u_headroom = float(u_headroom)
+        self.dev = None
+        self._full_upload(pairs)
+
+    # ------------------------------------------------------------------
+    def _collect_views(self, pairs):
+        views = []
+        for a, b in pairs:
+            va = lanecache.view_for(a.ct)
+            vb = lanecache.view_for(b.ct)
+            if va is None or vb is None or not lanecache.compatible(
+                    (va, vb)):
+                return None
+            views.append((va, vb))
+        return views
+
+    def _full_upload(self, pairs):
+        views = self._collect_views(pairs)
+        if views is None:
+            raise s.CausalError(
+                "fleet outside the device domain (PackSpec overflow?)",
+                {"causes": {"outside-domain"}},
+            )
+        cap = next_pow2(max(max(va.n, vb.n) for va, vb in views))
+        if cap < self.capacity:
+            cap = self.capacity  # never shrink: resident shapes are fixed
+        lanes = _assemble_rows(views, cap, bufs=self._bufs)
+        from ..benchgen import v5_token_budget
+
+        u = v5_token_budget(lanes)
+        self.u_max = max(self.u_max,
+                         int(u * self._u_headroom) + self.d_max)
+        self.capacity = cap
+        self.dev = {k: jnp.asarray(v) for k, v in lanes.items()}
+        self._views = views
+        self._uploaded_n = np.array(
+            [[va.n, vb.n] for va, vb in views], np.int32
+        )
+        self._uploaded_k = np.array(
+            [int(va.segments()["sg_len"].shape[0]) for va, _ in views],
+            np.int32,
+        )
+        # what the delta path must verify survived unchanged: the
+        # per-lane segment ordinals of every uploaded prefix (an
+        # interior stab restructures them) and the interner rank
+        # generation (a reassignment repacks every lo)
+        self._uploaded_rol = [
+            (va.segments()["run_of_lane"], vb.segments()["run_of_lane"])
+            for va, vb in views
+        ]
+        self._gen = views[0][0].interner.generation
+        self.pairs = list(pairs)
+
+    # ------------------------------------------------------------------
+    def update(self, pairs: Sequence[Tuple[object, object]]):
+        """Ship this wave's edits. Appends ride the delta path; anything
+        else (dropped caches, oversized deltas, capacity growth) falls
+        back to a full re-upload."""
+        pairs = list(pairs)
+        if len(pairs) != len(self._views):
+            return self._full_upload(pairs)
+        views = self._collect_views(pairs)
+        if views is None:
+            raise s.CausalError(
+                "fleet outside the device domain",
+                {"causes": {"outside-domain"}},
+            )
+        if views[0][0].interner.generation != self._gen:
+            # rank reassignment since upload: resident lo/sg packs are
+            # old-generation, deltas would be new-generation
+            return self._full_upload(pairs)
+        B = len(pairs)
+        cap = self.capacity
+        d_max = self.d_max
+        starts = np.zeros((B, 2), np.int32)
+        counts = np.zeros((B, 2), np.int32)
+        deltas = {c: np.full((B, 2, d_max), _PAD[c],
+                             self.dev[c].dtype if c != "valid" else bool)
+                  for c in _LANE_COLS}
+        tables = {k: [] for k in SEG_LANE_KEYS}
+        b_shift = np.zeros(B, np.int32)
+        old_nb = np.zeros(B, np.int32)
+        s_needed = 0
+        for r, ((va, vb), (ova, ovb)) in enumerate(
+                zip(views, self._views)):
+            for t, (v, ov) in enumerate(((va, ova), (vb, ovb))):
+                n0 = int(self._uploaded_n[r, t])
+                if (v.arena is not ov.arena and ov.arena.nodes[:n0]
+                        != v.arena.nodes[:n0]):
+                    return self._full_upload(pairs)  # rewritten history
+                if v.n < n0 or v.n - n0 > d_max or v.n > cap:
+                    return self._full_upload(pairs)
+                # an append that stabbed an old interior lane
+                # restructures the uploaded prefix's segment ordinals —
+                # the resident seg lane would be silently stale
+                if not np.array_equal(
+                        v.segments()["run_of_lane"][:n0],
+                        self._uploaded_rol[r][t][:n0]):
+                    return self._full_upload(pairs)
+            segs_a, segs_b = va.segments(), vb.segments()
+            ka = int(segs_a["sg_len"].shape[0])
+            kb = int(segs_b["sg_len"].shape[0])
+            s_needed = max(s_needed, ka + kb)
+        s_max = self.dev["sg_len"].shape[1]
+        if s_needed > s_max:
+            return self._full_upload(pairs)
+
+        for r, ((va, vb), _old) in enumerate(zip(views, self._views)):
+            segs_a, segs_b = va.segments(), vb.segments()
+            ka = int(segs_a["sg_len"].shape[0])
+            old_ka = int(self._uploaded_k[r])
+            b_shift[r] = ka - old_ka
+            old_nb[r] = int(self._uploaded_n[r, 1])
+            for t, (v, segs) in enumerate(((va, segs_a), (vb, segs_b))):
+                a = v.arena
+                n0 = int(self._uploaded_n[r, t])
+                d = v.n - n0
+                starts[r, t] = n0
+                counts[r, t] = d
+                if d:
+                    sl = slice(n0, v.n)
+                    deltas["hi"][r, t, :d] = a.ts[sl]
+                    deltas["lo"][r, t, :d] = a.spec.pack_lo(
+                        a.site[sl], a.tx[sl]
+                    )
+                    ci = a.cause_idx[sl]
+                    deltas["cci"][r, t, :d] = np.where(
+                        ci >= 0, ci + t * cap, -1
+                    )
+                    deltas["vc"][r, t, :d] = a.vclass[sl]
+                    deltas["valid"][r, t, :d] = True
+                    base = 0 if t == 0 else ka
+                    deltas["seg"][r, t, :d] = (
+                        segs["run_of_lane"][n0:v.n] + base
+                    )
+                self._uploaded_n[r, t] = v.n
+            self._uploaded_k[r] = ka
+            self._uploaded_rol[r] = (
+                segs_a["run_of_lane"], segs_b["run_of_lane"]
+            )
+            # small per-row tables, rebuilt host-side every wave via the
+            # shared layout helper
+            row, _bases = concat_seg_tables(
+                [(segs_a, int(self._uploaded_n[r, 0])),
+                 (segs_b, int(self._uploaded_n[r, 1]))],
+                cap, s_max,
+            )
+            for k in SEG_LANE_KEYS:
+                tables[k].append(row[k])
+
+        self.dev = _apply_deltas(
+            self.dev,
+            {c: jnp.asarray(deltas[c]) for c in _LANE_COLS},
+            jnp.asarray(starts), jnp.asarray(counts),
+            jnp.asarray(b_shift), jnp.asarray(old_nb),
+        )
+        for k in SEG_LANE_KEYS:
+            self.dev[k] = jnp.asarray(np.stack(tables[k]))
+        self._views = views
+        self.pairs = pairs
+
+    # ------------------------------------------------------------------
+    def wave(self):
+        """One merge wave over the resident lanes. Returns the [B]
+        digest array (fetched); rank/visible stay on device as
+        ``self.last_rank`` / ``self.last_visible``."""
+        from ..benchgen import LANE_KEYS5
+        from ..weaver.jaxw5 import batched_merge_weave_v5
+
+        r, v, _c, ov = batched_merge_weave_v5(
+            *(self.dev[k] for k in LANE_KEYS5),
+            u_max=self.u_max, k_max=self.u_max,
+        )
+        digest = _digest_fn()(self.dev["hi"], self.dev["lo"], r, v)
+        self.last_rank = r
+        self.last_visible = v
+        self.last_overflow = ov
+        out = np.asarray(digest)
+        if bool(np.asarray(ov).any()):
+            raise s.CausalError(
+                "wave overflowed the session's token budget; raise "
+                "u_headroom or re-create the session",
+                {"causes": {"token-overflow"},
+                 "rows": np.flatnonzero(np.asarray(ov)).tolist()},
+            )
+        return out
+
+    def merged(self, i: int):
+        """Materialize pair ``i``'s converged tree (host handle) from
+        the last wave."""
+        from .wave import WaveResult
+
+        res = WaveResult(
+            self.pairs, self._views, self.capacity,
+            np.asarray(self.last_rank), np.asarray(self.last_visible),
+            np.zeros(len(self.pairs), np.uint32), {}, "v5",
+        )
+        return res.merged(i)

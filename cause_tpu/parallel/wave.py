@@ -34,7 +34,7 @@ import jax.numpy as jnp
 from ..collections import shared as s
 from ..weaver import lanecache
 from ..weaver.arrays import I32_MAX, next_pow2
-from ..weaver.segments import SEG_LANE_KEYS, concat_segments
+from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
 
 __all__ = ["merge_wave", "WaveResult", "WaveBuffers"]
 
@@ -59,7 +59,6 @@ class WaveBuffers:
         self.shape = None
         self.lanes = None
         self.prev_n = None   # [B, 2] lanes written last wave, per tree
-        self.prev_k = None   # [B] segment-table entries written last wave
 
     def ensure(self, B: int, cap: int, s_max: int):
         shape = (B, cap, s_max)
@@ -84,7 +83,6 @@ class WaveBuffers:
                 "sg_vsum": np.zeros((B, s_max), np.int32),
             }
             self.prev_n = np.zeros((B, 2), np.int64)
-            self.prev_k = np.zeros(B, np.int64)
             self.shape = shape
         return self.lanes
 
@@ -114,16 +112,12 @@ def _assemble_rows(views: Sequence[Tuple["lanecache.LaneView",
     lanes = bufs.ensure(B, cap, s_max)
     hi, lo, cci = lanes["hi"], lanes["lo"], lanes["cci"]
     vc, valid, seg = lanes["vc"], lanes["valid"], lanes["seg"]
-    # segment-table column map (concat_segments' layout, written
-    # straight into the reused buffers instead of per-row allocations)
-    seg_cols = (
-        ("sg_min_hi", "sg_min_hi"), ("sg_min_lo", "sg_min_lo"),
-        ("sg_max_hi", "sg_max_hi"), ("sg_max_lo", "sg_max_lo"),
-        ("sg_len", "sg_len"), ("sg_dense", "sg_dense"),
-        ("sg_tail_special", "sg_tail_special"), ("sg_vsum", "sg_vsum"),
-    )
     for r, (va, vb) in enumerate(views):
-        base = 0
+        # segment tables: the shared layout helper writes straight into
+        # this row's (reused) buffer views
+        row_out = {k: lanes[k][r] for k in SEG_LANE_KEYS}
+        _t, bases = concat_seg_tables(per_row_segs[r], cap,
+                                      s_max, out=row_out)
         for t, v in enumerate((va, vb)):
             v.arena.sync_ranks()
             a, n = v.arena, v.n
@@ -136,28 +130,13 @@ def _assemble_rows(views: Sequence[Tuple["lanecache.LaneView",
             vc[r, sl] = a.vclass[:n]
             valid[r, sl] = True
             segs = per_row_segs[r][t][0]
-            k = segs["sg_len"].shape[0]
-            if base + k > s_max:  # cannot happen: s_max covers the max
-                raise OverflowError(f"segment budget {s_max} < {base + k}")
-            tsl = slice(base, base + k)
-            for dst, src in seg_cols:
-                lanes[dst][r, tsl] = segs[src]
-            lanes["sg_lane0"][r, tsl] = segs["sg_head_lane"] + off
-            lanes["sg_valid"][r, tsl] = True
-            seg[r, sl] = segs["run_of_lane"][:n] + base
-            base += k
+            seg[r, sl] = segs["run_of_lane"][:n] + bases[t]
             prev = int(bufs.prev_n[r, t])
             if prev > n:  # re-pad the shrink gap
                 gap = slice(off + n, off + prev)
                 for key, pad in _PAD.items():
                     lanes[key][r, gap] = pad
             bufs.prev_n[r, t] = n
-        prev_k = int(bufs.prev_k[r])
-        if prev_k > base:  # invalidate the leftover table tail
-            tgap = slice(base, prev_k)
-            lanes["sg_valid"][r, tgap] = False
-            lanes["sg_len"][r, tgap] = 0
-        bufs.prev_k[r] = base
     return lanes
 
 

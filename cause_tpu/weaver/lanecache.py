@@ -169,6 +169,17 @@ def interner_for(uuid: str) -> SharedInterner:
     return it
 
 
+def _seg_cache_put(cache: dict, n: int, segs) -> None:
+    """Shared bounded-insert policy for arena segment caches (callers
+    hold whatever locking they need)."""
+    if len(cache) >= 4:
+        try:
+            cache.pop(min(cache))
+        except (ValueError, KeyError):
+            pass  # concurrent evictor got there first
+    cache[n] = segs
+
+
 class LaneArena:
     """Append-only lane arena shared by successive versions of one
     tree. ``committed_n`` is the arena tip: a view owning the tip may
@@ -300,13 +311,7 @@ class LaneView:
             hi, lo = na.id_lanes()
             segs = tree_segments(hi, lo, na.cause_idx, na.vclass, na.n)
             with self.arena.lock:
-                cache = self.arena.seg_cache
-                if len(cache) >= 4:
-                    try:
-                        cache.pop(min(cache))
-                    except (ValueError, KeyError):
-                        pass  # concurrent evictor got there first
-                cache[self.n] = segs
+                _seg_cache_put(self.arena.seg_cache, self.n, segs)
         return segs
 
 
@@ -446,6 +451,21 @@ def extend_view(view: Optional[LaneView], new_nodes) -> Optional[LaneView]:
             lane_of[nid] = i
             i += 1
         arena.committed_n = n + k
+        # extend the memoized segment tables in O(k) when the append
+        # shape allows (segments.extend_segments); a bail just leaves
+        # the next device use to recompute lazily
+        old_segs = arena.seg_cache.get(n)
+        if old_segs is not None:
+            from .segments import extend_segments
+
+            lo_win = spec.pack_lo(arena.site[n - 1: n + k],
+                                  arena.tx[n - 1: n + k])
+            new_segs = extend_segments(
+                old_segs, arena.ts, lo_win, arena.cause_idx,
+                arena.vclass, n, n + k,
+            )
+            if new_segs is not None:
+                _seg_cache_put(arena.seg_cache, n + k, new_segs)
     return LaneView(arena, n + k)
 
 

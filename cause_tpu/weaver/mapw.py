@@ -125,24 +125,37 @@ def forest_lanes(nodes_map: dict, key_rank: Dict[object, int],
 
     idx_of = {nid: 1 + n_keys + i for i, nid in enumerate(ids)}
     rank = interner.rank
-    for i, nid in enumerate(ids):
-        lane = 1 + n_keys + i
-        cause, value = nodes_map[nid]
-        hi[lane] = nid[0]
-        lo[lane] = spec.pack_lo(np.int32(rank[nid[1]]), np.int32(nid[2]))
-        vc[lane] = vclass_of(value)
-        valid[lane] = True
-        lane_nodes[lane] = (nid, cause, value)
-        if is_id(cause):
-            t = idx_of.get(tuple(cause))
-            if t is None:
-                raise OutsideDomain()  # dangling target
-            target_cause = nodes_map[tuple(cause)][0]
-            if is_id(target_cause):
-                raise OutsideDomain()  # id-caused targeting id-caused
-            cci[lane] = t
-        else:
-            cci[lane] = key_lane[cause]
+    n_real = len(ids)
+    if n_real:
+        base = 1 + n_keys
+        sl = slice(base, base + n_real)
+        # vectorized columns (dict lookups stay Python — they carry the
+        # domain checks — but the numeric packing is numpy)
+        hi[sl] = np.fromiter((nid[0] for nid in ids), np.int64, n_real)
+        site_r = np.fromiter((rank[nid[1]] for nid in ids), np.int64,
+                             n_real)
+        tx_r = np.fromiter((nid[2] for nid in ids), np.int64, n_real)
+        lo[sl] = spec.pack_lo(site_r.astype(np.int32),
+                              tx_r.astype(np.int32))
+        valid[sl] = True
+        bodies = [nodes_map[nid] for nid in ids]
+        vc[sl] = np.fromiter((vclass_of(v) for _, v in bodies), np.int32,
+                             n_real)
+
+        def resolve(cause):
+            if is_id(cause):
+                t = idx_of.get(tuple(cause))
+                if t is None:
+                    raise OutsideDomain()  # dangling target
+                if is_id(nodes_map[tuple(cause)][0]):
+                    raise OutsideDomain()  # id-caused targeting id-caused
+                return t
+            return key_lane[cause]
+
+        cci[sl] = np.fromiter((resolve(c) for c, _ in bodies), np.int64,
+                              n_real)
+        for i, nid in enumerate(ids):
+            lane_nodes[base + i] = (nid, bodies[i][0], bodies[i][1])
     return hi, lo, cci, vc, valid, lane_nodes, lane_keys
 
 

@@ -38,7 +38,13 @@ from typing import Dict
 
 import numpy as np
 
-__all__ = ["tree_segments", "concat_segments", "SEG_KEYS", "SEG_LANE_KEYS"]
+__all__ = [
+    "tree_segments",
+    "extend_segments",
+    "concat_segments",
+    "SEG_KEYS",
+    "SEG_LANE_KEYS",
+]
 
 SEG_KEYS = (
     "sg_head_lane",  # lane of the segment head (tree coordinates)
@@ -194,32 +200,28 @@ def tree_segments(hi, lo, cause_idx, vclass, n: int) -> Dict[str, np.ndarray]:
     }
 
 
-def concat_segments(per_tree, capacity: int, s_max: int) -> Dict[str, np.ndarray]:
-    """Assemble per-tree segment tables into the device kernel's concat
-    layout: ``per_tree`` is a list of (``tree_segments`` result, n)
-    tuples, each tree occupying ``capacity`` concat lanes in order.
+_TABLE_DTYPES = {
+    "sg_min_hi": np.int32, "sg_min_lo": np.int32,
+    "sg_max_hi": np.int32, "sg_max_lo": np.int32,
+    "sg_len": np.int32, "sg_lane0": np.int32,
+    "sg_dense": bool, "sg_tail_special": bool,
+    "sg_valid": bool, "sg_vsum": np.int32,
+}
 
-    Returns the ``SEG_LANE_KEYS`` arrays padded to ``s_max`` (in lane
-    order — marshal order IS ascending concat lane order, which the
-    kernel's expansion scans rely on) plus ``seg`` ([n_trees*capacity]
-    int32): every concat lane's segment ordinal (-1 padding).
-    """
-    n_trees = len(per_tree)
-    out = {
-        "sg_min_hi": np.full(s_max, 0, np.int32),
-        "sg_min_lo": np.full(s_max, 0, np.int32),
-        "sg_max_hi": np.full(s_max, 0, np.int32),
-        "sg_max_lo": np.full(s_max, 0, np.int32),
-        "sg_len": np.zeros(s_max, np.int32),
-        "sg_lane0": np.zeros(s_max, np.int32),
-        "sg_dense": np.zeros(s_max, bool),
-        "sg_tail_special": np.zeros(s_max, bool),
-        "sg_valid": np.zeros(s_max, bool),
-        "sg_vsum": np.zeros(s_max, np.int32),
-    }
-    seg = np.full(n_trees * capacity, -1, np.int32)
+
+def concat_seg_tables(per_tree, capacity: int, s_max: int,
+                      out: Dict[str, np.ndarray] = None):
+    """Fill the ``SEG_LANE_KEYS`` table arrays for one concat row —
+    the single place that knows the layout (wave assembly, delta
+    sessions, and ``concat_segments`` all route through it). ``out``
+    may carry preallocated [s_max] arrays (e.g. batch-row views);
+    entries beyond each tree's tables are zeroed/invalidated. Returns
+    ``(out, bases)`` with each tree's starting segment ordinal."""
+    if out is None:
+        out = {k: np.zeros(s_max, dt) for k, dt in _TABLE_DTYPES.items()}
+    bases = []
     base = 0
-    for t, (segs, n) in enumerate(per_tree):
+    for t, (segs, _n) in enumerate(per_tree):
         k = segs["sg_len"].shape[0]
         if base + k > s_max:
             raise OverflowError(
@@ -236,9 +238,172 @@ def concat_segments(per_tree, capacity: int, s_max: int) -> Dict[str, np.ndarray
         out["sg_tail_special"][sl] = segs["sg_tail_special"]
         out["sg_vsum"][sl] = segs["sg_vsum"]
         out["sg_valid"][sl] = True
+        bases.append(base)
+        base += k
+    if base < s_max:  # invalidate any leftover tail (reused buffers)
+        tail = slice(base, s_max)
+        out["sg_valid"][tail] = False
+        out["sg_len"][tail] = 0
+    return out, bases
+
+
+def concat_segments(per_tree, capacity: int, s_max: int) -> Dict[str, np.ndarray]:
+    """Assemble per-tree segment tables into the device kernel's concat
+    layout: ``per_tree`` is a list of (``tree_segments`` result, n)
+    tuples, each tree occupying ``capacity`` concat lanes in order.
+
+    Returns the ``SEG_LANE_KEYS`` arrays padded to ``s_max`` (in lane
+    order — marshal order IS ascending concat lane order, which the
+    kernel's expansion scans rely on) plus ``seg`` ([n_trees*capacity]
+    int32): every concat lane's segment ordinal (-1 padding).
+    """
+    n_trees = len(per_tree)
+    out, bases = concat_seg_tables(per_tree, capacity, s_max)
+    seg = np.full(n_trees * capacity, -1, np.int32)
+    for t, ((segs, n), base) in enumerate(zip(per_tree, bases)):
         rl = segs["run_of_lane"]
         lane_sl = slice(t * capacity, t * capacity + n)
         seg[lane_sl] = rl[:n] + base
-        base += k
     out["seg"] = seg
+    return out
+
+
+def extend_segments(segs, hi, lo_win, cause_idx, vclass, n_old: int,
+                    n_new: int):
+    """O(k) extension of a tree's segment tables for appended lanes
+    ``[n_old, n_new)`` — the segment twin of the lane cache's append
+    fast path (a 10k-tree ``tree_segments`` costs ~1 ms; a sync fleet
+    recomputing it per edited replica per wave pays seconds).
+
+    ``hi``/``cause_idx``/``vclass`` are full arena columns (free);
+    ``lo_win`` covers lanes ``[n_old-1, n_new)`` only, so the caller
+    never packs the whole tree. Returns the new tables, or None when
+    the append shape needs a full recompute. The *simple-append
+    domain* (everything conj/extend/cons/tail-tombstones mint):
+
+    - every appended cause resolves to the appended chain (i-1), the
+      old tail (n_old-1), the root (0), or nothing (-1);
+    - a non-special appended whose host jump would walk past a SPECIAL
+      old tail into old lanes is out.
+
+    Within that domain OLD glue bits cannot change: new children
+    attach only to the old tail (whose contestedness affects only lane
+    n_old's glue) or the root (always a singleton) — so the old tables
+    survive verbatim except that the LAST segment may extend, and the
+    appended lanes segment locally. Fuzz-checked against from-scratch
+    ``tree_segments`` (tests/test_lanecache.py).
+    """
+    k = n_new - n_old
+    n_segs_old = segs["sg_len"].shape[0]
+    if n_old < 2 or k <= 0 or n_segs_old == 0:
+        return None
+
+    def LO(lane):
+        return lo_win[lane - (n_old - 1)]
+
+    idx = np.arange(n_old, n_new, dtype=np.int64)
+    ci = cause_idx[n_old:n_new].astype(np.int64)
+    special = vclass[n_old:n_new] > 0
+    chain = ci == idx - 1          # includes the boundary lane n_old
+    to_tail = ci == n_old - 1
+    to_root = ci == 0
+    none_c = ci == -1
+    if not bool(np.all(chain | to_tail | to_root | none_c)):
+        return None  # stabs an old interior lane: recompute
+    old_tail_special = bool(vclass[n_old - 1] > 0)
+
+    # parents (for contestedness): specials hang off their cause,
+    # non-specials off the first non-special through the chain. -2
+    # stands for root/none (harmless: their glue is already fixed).
+    parent = np.full(k, -2, np.int64)
+    for j in range(k):
+        if special[j]:
+            c = ci[j]
+            parent[j] = c if c >= n_old - 1 else -2
+            continue
+        p = ci[j]
+        while p >= n_old and vclass[int(p)] > 0:
+            p = cause_idx[int(p)]
+        if p == n_old - 1 and old_tail_special:
+            return None  # host walk would continue into old lanes
+        if p >= n_old - 1:
+            parent[j] = p
+        else:
+            parent[j] = -2
+
+    prev_special = np.concatenate([[old_tail_special], special[:-1]])
+    adj = chain
+    host_case = adj & ~special & prev_special
+    irregular = ~adj | host_case
+    contested = set(int(p) for p in parent[irregular] if p >= 0)
+    prev_contested = np.fromiter(
+        (int(p) in contested for p in idx - 1), bool, k
+    )
+    lo_cur = lo_win[1:]
+    lo_prev = lo_win[:-1]
+    hi_cur = hi[n_old:n_new]
+    hi_prev = hi[n_old - 1:n_new - 1]
+    dense_hi_p = (lo_cur == lo_prev) & (hi_cur == hi_prev + 1)
+    dense_lo_p = (hi_cur == hi_prev) & (lo_cur == lo_prev + 1)
+    glued = adj & ~host_case & ~prev_contested & (dense_hi_p | dense_lo_p)
+    pat = dense_lo_p
+
+    # boundary pattern consistency with the old last segment
+    old_len = int(segs["sg_len"][-1])
+    if glued[0] and old_len > 1:
+        old_lo_pat = bool(segs["sg_max_hi"][-1] == segs["sg_min_hi"][-1])
+        if bool(pat[0]) != old_lo_pat:
+            glued[0] = False
+    for j in range(1, k):  # alternation cut within the appended run
+        if glued[j] and glued[j - 1] and bool(pat[j]) != bool(pat[j - 1]):
+            glued[j] = False
+
+    # run ids for the appended lanes
+    last = n_segs_old - 1
+    rid = np.empty(k, np.int64)
+    cur = last
+    new_heads = []
+    for j in range(k):
+        if not glued[j]:
+            cur += 1
+            new_heads.append((cur, n_old + j))
+        rid[j] = cur
+    n_segs_new = cur + 1
+
+    rol = segs["run_of_lane"]
+    if n_new > rol.shape[0]:
+        grown = np.full(max(n_new, 2 * rol.shape[0]), -1, np.int32)
+        grown[: rol.shape[0]] = rol
+        rol = grown
+    else:
+        rol = rol.copy()
+    rol[n_old:n_new] = rid.astype(np.int32)
+
+    out = {"run_of_lane": rol}
+    for key in SEG_KEYS:
+        grow = np.zeros(n_segs_new, segs[key].dtype)
+        grow[:n_segs_old] = segs[key]
+        out[key] = grow
+    for sg, head in new_heads:
+        out["sg_head_lane"][sg] = head
+        out["sg_min_hi"][sg] = hi[head]
+        out["sg_min_lo"][sg] = LO(head)
+        out["sg_dense"][sg] = True  # glue requires a dense pattern
+    # per-touched-segment tails/lengths/checksums
+    for sg in range(last, n_segs_new):
+        mask = rid == sg
+        c = int(mask.sum())
+        if c == 0:
+            continue  # the old last segment gained nothing
+        lanes = np.flatnonzero(mask) + n_old
+        tail = int(lanes[-1])
+        base_len = int(out["sg_len"][sg]) if sg == last else 0
+        out["sg_len"][sg] = base_len + c
+        out["sg_max_hi"][sg] = hi[tail]
+        out["sg_max_lo"][sg] = LO(tail)
+        out["sg_tail_special"][sg] = bool(vclass[tail] > 0)
+        w = (base_len + np.arange(1, c + 1, dtype=np.int64)) * vclass[lanes]
+        out["sg_vsum"][sg] = np.int32(
+            (int(out["sg_vsum"][sg]) + int(w.sum())) & 0x7FFFFFFF
+        )
     return out

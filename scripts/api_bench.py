@@ -135,6 +135,41 @@ def wave_bench(args):
     res = merge_wave(pairs)
     t_mat = timed(lambda: res.merged(0), reps=args.reps)
 
+    # --- device-resident session: the steady-state loop --------------
+    from cause_tpu.parallel.session import FleetSession
+
+    sess = FleetSession(pairs)
+    sess.wave()
+    w = [0]
+
+    def edit_all():
+        w[0] += 1
+        return [(x.conj(f"s{w[0]}x"), y.extend([f"s{w[0]}y"]))
+                for x, y in sess.pairs]
+
+    sess.update(edit_all())
+    sess.wave()  # compile the delta path
+    t_edits, t_rounds = [], []
+    for _ in range(args.reps + 1):
+        t0 = time.perf_counter()
+        nxt = edit_all()
+        t1 = time.perf_counter()
+        sess.update(nxt)
+        sess.wave()
+        t2 = time.perf_counter()
+        t_edits.append((t1 - t0) * 1000)
+        t_rounds.append((t2 - t1) * 1000)
+    print(json.dumps({
+        "metric": "device-resident session round",
+        "pairs": B,
+        "edit_all_replicas_ms": round(float(np.median(t_edits[1:])), 1),
+        "delta_update_plus_wave_ms": round(
+            float(np.median(t_rounds[1:])), 1
+        ),
+        "unit": "ms",
+        "platform": platform,
+    }), flush=True)
+
     _, n_over = kernel_once()
     print(json.dumps({
         "metric": f"merge wave {B} pairs x {n_base + n_div + 1}-node "

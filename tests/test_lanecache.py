@@ -202,3 +202,77 @@ def test_invalidation_fuzz():
         assert cl2.ct.lanes is not None
         assert_view_matches_scratch(cl2.ct)
         assert c.causal_to_edn(cl2) == c.causal_to_edn(pure)
+
+
+def assert_segments_match_scratch(ct):
+    """Oracle: the (possibly incrementally extended) cached segment
+    tables must equal a from-scratch tree_segments run."""
+    from cause_tpu.weaver.segments import SEG_KEYS, tree_segments
+
+    view = ct.lanes
+    if view is None:
+        return
+    segs = view.arena.seg_cache.get(view.n)
+    if segs is None:
+        return  # nothing cached: nothing to diverge
+    na = view.node_arrays()
+    hi, lo = na.id_lanes()
+    ref = tree_segments(hi, lo, na.cause_idx, na.vclass, na.n)
+    for key in SEG_KEYS:
+        assert np.array_equal(np.asarray(segs[key]),
+                              np.asarray(ref[key])), key
+    n = view.n
+    assert np.array_equal(segs["run_of_lane"][:n], ref["run_of_lane"][:n])
+
+
+def test_incremental_segments_on_append_paths():
+    cl = warm(c.clist(weaver="jax").extend(["x"] * 40))
+    cl.ct.lanes.segments()  # prime the cache
+    # conj chain (hi-dense), extend run (lo-dense), cons (root stab),
+    # tail tombstone — every simple-append shape
+    cl = cl.conj("a").conj("b")
+    assert_segments_match_scratch(cl.ct)
+    cl = cl.extend([f"e{i}" for i in range(7)])
+    assert_segments_match_scratch(cl.ct)
+    cl = cl.cons("front")
+    assert_segments_match_scratch(cl.ct)
+    tail = cl.ct.weave[-1][0]
+    cl = cl.append(tail, c.hide)  # tombstone of the weave tail
+    assert_segments_match_scratch(cl.ct)
+    # a non-special after the special tail is out of the simple domain:
+    # the cache must recompute, not diverge
+    cl = cl.conj("after-hide")
+    assert_segments_match_scratch(cl.ct)
+    cl2 = warm(cl)
+    assert_segments_match_scratch(cl2.ct)
+
+
+@pytest.mark.slow
+def test_incremental_segments_fuzz():
+    rng = random.Random(77)
+    for round_ in range(10):
+        cl = warm(c.clist(weaver="jax").extend(
+            [f"s{i}" for i in range(rng.randrange(2, 40))]
+        ))
+        cl.ct.lanes.segments()
+        for step in range(rng.randrange(6, 24)):
+            op = rng.randrange(6)
+            if op == 0:
+                cl = cl.extend([f"v{round_}.{step}.{j}"
+                                for j in range(rng.randrange(1, 9))])
+            elif op == 1:
+                cl = cl.conj(f"c{step}")
+            elif op == 2:
+                cl = cl.cons(f"f{step}")
+            elif op == 3 and len(cl.ct.weave) > 2:
+                target = rng.choice(cl.ct.weave[1:])[0]
+                cl = cl.append(target, c.hide)  # interior stab: bails
+            elif op == 4 and len(cl.ct.weave) > 1:
+                cl = cl.append(cl.ct.weave[-1][0], c.hide)  # tail hide
+            else:
+                fork = CausalList(
+                    cl.ct.evolve(site_id=new_site_id())
+                ).conj(f"fk{step}")
+                cl = cl.merge(fork)
+            assert_segments_match_scratch(cl.ct)
+            assert_view_matches_scratch(cl.ct)

@@ -1,0 +1,149 @@
+"""Device-resident fleet sessions: delta updates must converge to
+exactly what full re-uploads (and pairwise merges) produce."""
+
+import numpy as np
+import pytest
+
+import cause_tpu as c
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id
+from cause_tpu.parallel import merge_wave
+from cause_tpu.parallel.session import FleetSession
+
+
+def warm(cl):
+    return CausalList(c_list.weave(cl.ct))
+
+
+def make_pairs(n_pairs, n_base=50, n_div=6):
+    base = warm(c.clist(weaver="jax").extend(
+        [f"w{i}" for i in range(n_base)]
+    ))
+    base.ct.lanes.segments()
+    pairs = []
+    for p in range(n_pairs):
+        a = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+            [f"a{p}.{i}" for i in range(n_div)]
+        )
+        b = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+            [f"b{p}.{i}" for i in range(n_div)]
+        )
+        pairs.append((a, b))
+    return pairs
+
+
+def test_session_waves_match_pairwise_merges():
+    pairs = make_pairs(5)
+    sess = FleetSession(pairs)
+    d0 = sess.wave()
+    # digests agree with the one-shot wave API on identical input
+    res = merge_wave(pairs)
+    assert np.array_equal(d0, res.digest)
+    for i, (a, b) in enumerate(pairs):
+        assert c.causal_to_edn(sess.merged(i)) == c.causal_to_edn(
+            a.merge(b)
+        )
+
+    # wave 2: every replica edits (the delta path)
+    pairs2 = [
+        (a.conj("xa").extend(["ya", "za"]), b.conj("xb"))
+        for a, b in pairs
+    ]
+    sess.update(pairs2)
+    d1 = sess.wave()
+    assert not np.array_equal(d0, d1)
+    res2 = merge_wave(pairs2)
+    assert np.array_equal(d1, res2.digest)
+    for i, (a, b) in enumerate(pairs2):
+        assert c.causal_to_edn(sess.merged(i)) == c.causal_to_edn(
+            a.merge(b)
+        )
+
+    # wave 3: tombstones + more appends
+    pairs3 = []
+    for a, b in pairs2:
+        a = a.append(list(a)[-1][0], c.hide)
+        b = b.extend(["tail"])
+        pairs3.append((a, b))
+    sess.update(pairs3)
+    d2 = sess.wave()
+    res3 = merge_wave(pairs3)
+    assert np.array_equal(d2, res3.digest)
+    for i, (a, b) in enumerate(pairs3):
+        assert c.causal_to_edn(sess.merged(i)) == c.causal_to_edn(
+            a.merge(b)
+        )
+
+
+def test_session_full_reupload_fallbacks():
+    pairs = make_pairs(3)
+    sess = FleetSession(pairs, d_max=4)
+    sess.wave()
+    # a delta larger than d_max forces (and survives) a full re-upload
+    pairs2 = [(a.extend([f"big{i}" for i in range(9)]), b)
+              for a, b in pairs]
+    sess.update(pairs2)
+    d = sess.wave()
+    res = merge_wave(pairs2)
+    assert np.array_equal(d, res.digest)
+    # a dropped cache (mid-order foreign insert) also falls back
+    a0, b0 = pairs2[0]
+    foreign = ((0, "zzzzzzzzzzzzz", 0), c.root_id, "old")
+    pairs3 = [(a0.insert(foreign), b0)] + pairs2[1:]
+    sess.update(pairs3)
+    d3 = sess.wave()
+    for i, (a, b) in enumerate(pairs3):
+        assert c.causal_to_edn(sess.merged(i)) == c.causal_to_edn(
+            a.merge(b)
+        )
+
+
+def test_session_capacity_growth():
+    pairs = make_pairs(2, n_base=10, n_div=2)
+    sess = FleetSession(pairs, d_max=8)
+    sess.wave()
+    # grow one tree past the session capacity: re-upload at bigger cap
+    pairs2 = [(pairs[0][0].extend([f"g{i}" for i in range(40)]),
+               pairs[0][1])] + pairs[1:]
+    sess.update(pairs2)
+    d = sess.wave()
+    res = merge_wave(pairs2)
+    assert np.array_equal(d, res.digest)
+
+
+def test_session_detects_interior_stab_restructuring():
+    """An append that tombstones an old INTERIOR element restructures
+    the uploaded prefix's segment ordinals; the delta path must detect
+    it and fall back (regression: resident seg lanes went silently
+    stale and digests diverged from merge_wave)."""
+    pairs = make_pairs(3)
+    sess = FleetSession(pairs)
+    sess.wave()
+    a0, b0 = sess.pairs[0]
+    victim = list(a0)[5][0]  # interior element
+    pairs2 = [(a0.append(victim, c.hide), b0)] + sess.pairs[1:]
+    sess.update(pairs2)
+    d = sess.wave()
+    res = merge_wave(pairs2)
+    assert np.array_equal(d, res.digest)
+    for i, (a, b) in enumerate(pairs2):
+        assert c.causal_to_edn(sess.merged(i)) == c.causal_to_edn(
+            a.merge(b)
+        )
+
+
+def test_session_detects_rank_reassignment():
+    """A gap-exhaustion rank reassignment repacks every lo; the delta
+    path must full-re-upload instead of splicing new-generation lanes
+    next to old-generation residents (regression: digests diverged)."""
+    pairs = make_pairs(3)
+    sess = FleetSession(pairs)
+    d0 = sess.wave()
+    it = sess._views[0][0].interner
+    it._reassign()
+    pairs2 = [(a.conj("post-reassign"), b) for a, b in sess.pairs]
+    sess.update(pairs2)
+    d = sess.wave()
+    res = merge_wave(pairs2)
+    assert np.array_equal(d, res.digest)
