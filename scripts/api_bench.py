@@ -186,12 +186,63 @@ def wave_bench(args):
     }), flush=True)
 
 
+def map_bench(args):
+    """Batched map-forest merge at fleet scale: B replica pairs of one
+    CausalMap through mapw.batched_merge_map_weave (VERDICT r2 #4's
+    bench row for maps)."""
+    import jax
+
+    import cause_tpu as c
+    from cause_tpu import K
+    from cause_tpu.collections.cmap import CausalMap
+    from cause_tpu.ids import new_site_id
+    from cause_tpu.weaver import mapw
+
+    B = args.maps
+    platform = jax.devices()[0].platform
+    base = c.cmap()
+    for i in range(args.n_keys):
+        base = base.append(K(f"k{i}"), f"v{i}")
+    pairs = []
+    for p in range(B):
+        a = CausalMap(base.ct.evolve(site_id=new_site_id()))
+        b = CausalMap(base.ct.evolve(site_id=new_site_id()))
+        for e in range(args.n_edits):
+            a = a.append(K(f"k{(p + e) % args.n_keys}"), f"a{p}.{e}")
+            b = b.append(K(f"x{e % 4}"), f"b{p}.{e}")
+        pairs.append((a.ct.nodes, b.ct.nodes))
+
+    t_marshal = timed(lambda: mapw.pair_rows(pairs), reps=args.reps)
+    lanes, meta = mapw.pair_rows(pairs)
+
+    def kernel():
+        o, r, v, _c_, ov = mapw.batched_merge_map_weave(lanes)
+        d = mapw.map_row_digest(lanes, r, v)
+        assert not bool(np.asarray(ov).any())
+        return int(d[0])
+
+    t_kernel = timed(kernel, reps=args.reps)
+    print(json.dumps({
+        "metric": f"batched map merge, {B} replica pairs x "
+                  f"{args.n_keys} keys + {args.n_edits} edits/side",
+        "host_marshal_ms": round(t_marshal, 1),
+        "device_kernel_ms": round(t_kernel, 1),
+        "capacity": meta["capacity"],
+        "platform": platform,
+        "unit": "ms",
+    }), flush=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n-base", type=int, default=9_000)
     ap.add_argument("--n-div", type=int, default=1_000)
     ap.add_argument("--wave", type=int, default=0,
                     help="batched wave of this many replica pairs")
+    ap.add_argument("--maps", type=int, default=0,
+                    help="batched MAP merge of this many replica pairs")
+    ap.add_argument("--n-keys", type=int, default=32)
+    ap.add_argument("--n-edits", type=int, default=16)
     ap.add_argument("--burst", type=int, default=8,
                     help="pipelined waves per amortized measurement")
     ap.add_argument("--reps", type=int, default=3)
@@ -203,6 +254,9 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax
 
+    if args.maps:
+        map_bench(args)
+        return
     if args.wave:
         wave_bench(args)
         return
