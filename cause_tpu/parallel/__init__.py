@@ -9,4 +9,5 @@ from .mesh import (  # noqa: F401
     sharded_merge_weave_v4,
     sharded_merge_weave_v5,
 )
-from .wave import WaveResult, merge_wave  # noqa: F401
+from .session import FleetSession  # noqa: F401
+from .wave import WaveResult, WaveBuffers, merge_wave  # noqa: F401

@@ -615,7 +615,19 @@ def merge_many_list_trees(cts):
 
     from . import lanecache
 
-    view = lanecache.build_view(nodes, first.uuid)
+    # marshal the union: fold cached views vectorized when every input
+    # carries a fresh, rank-compatible one (no dict sort, no per-node
+    # Python); otherwise one from-scratch build
+    view = None
+    in_views = [
+        ct.lanes if (isinstance(ct.lanes, lanecache.LaneView)
+                     and ct.lanes.n == len(ct.nodes)) else None
+        for ct in cts
+    ]
+    if all(v is not None for v in in_views):
+        view = lanecache.union_views_many(in_views)
+    if view is None:
+        view = lanecache.build_view(nodes, first.uuid)
     na = view.node_arrays() if view is not None \
         else NodeArrays.from_nodes_map(nodes)
     n = na.n

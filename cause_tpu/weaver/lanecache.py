@@ -66,6 +66,7 @@ __all__ = [
     "view_for",
     "compatible",
     "union_views",
+    "union_views_many",
 ]
 
 
@@ -503,19 +504,29 @@ def _packed_keys(a: LaneArena, n: int) -> np.ndarray:
 
 def union_views(va: LaneView, vb: LaneView) -> Optional[LaneView]:
     """Vectorized union of two cached views into a fresh view over the
-    merged node set — the marshal half of an accelerated pair merge
-    with NO per-node Python loop and no dict sort: packed-key argsort
-    of the concatenated lanes, adjacent-duplicate drop, and one
-    searchsorted pass to re-resolve causes against the union. Requires
-    ``compatible`` views (same interner generation, or the packed keys
-    would not be comparable); body conflicts between duplicate ids are
-    NOT checked here — callers run the append-only union validation
-    (shared.union_nodes semantics) before trusting the result."""
-    if not compatible((va, vb)):
+    merged node set (see ``union_views_many``)."""
+    return union_views_many((va, vb))
+
+
+def union_views_many(views) -> Optional[LaneView]:
+    """Vectorized K-way union of cached views into a fresh view over
+    the merged node set — the marshal half of an accelerated merge
+    with NO per-node Python loop and no dict sort: one packed-key
+    argsort of every view's concatenated lanes, adjacent-duplicate
+    drop, and one searchsorted pass to re-resolve causes against the
+    union. Requires ``compatible`` views (same interner generation, or
+    the packed keys would not be comparable); body conflicts between
+    duplicate ids are NOT checked here — callers run the append-only
+    union validation (shared.union_nodes semantics) before trusting
+    the result."""
+    views = list(views)
+    if not views or not compatible(views):
         return None
-    aa, ab = va.arena, vb.arena
-    na_, nb_ = va.n, vb.n
-    keys = np.concatenate([_packed_keys(aa, na_), _packed_keys(ab, nb_)])
+    arenas = [v.arena for v in views]
+    ns = [v.n for v in views]
+    keys = np.concatenate([
+        _packed_keys(a, n) for a, n in zip(arenas, ns)
+    ])
     order = np.argsort(keys, kind="stable")
     ks = keys[order]
     dup = np.zeros(len(ks), bool)
@@ -526,7 +537,7 @@ def union_views(va: LaneView, vb: LaneView) -> Optional[LaneView]:
 
     def col(name, fill):
         src = np.concatenate([
-            getattr(aa, name)[:na_], getattr(ab, name)[:nb_]
+            getattr(a, name)[:cnt] for a, cnt in zip(arenas, ns)
         ])
         out = np.full(cap, fill, src.dtype)
         out[:n] = src[kept]
@@ -549,14 +560,19 @@ def union_views(va: LaneView, vb: LaneView) -> Optional[LaneView]:
     cause_idx = np.full(cap, -1, np.int32)
     cause_idx[:n] = np.where(found, posc, -1)
 
+    # map each kept concat position back to its source (view, lane)
+    bounds = np.cumsum([0] + ns)
+    src_view = np.searchsorted(bounds, kept, side="right") - 1
+    src_lane = kept - bounds[src_view]
+    node_lists = [a.nodes for a in arenas]
     nodes = [
-        (aa.nodes[i] if i < na_ else ab.nodes[i - na_]) for i in kept
+        node_lists[int(v)][int(i)] for v, i in zip(src_view, src_lane)
     ]
     arena = LaneArena(
         ts=ts, site=site, tx=tx, cause_idx=cause_idx, vclass=vclass,
         cause_hi=cause_hi, cause_lo=cause_lo, nodes=nodes,
         lane_of={nid: i for i, (nid, _, _) in enumerate(nodes)},
-        interner=aa.interner, generation=va.generation, spec=aa.spec,
-        committed_n=n,
+        interner=arenas[0].interner, generation=views[0].generation,
+        spec=arenas[0].spec, committed_n=n,
     )
     return LaneView(arena, n)

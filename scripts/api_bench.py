@@ -215,11 +215,21 @@ def map_bench(args):
     t_marshal = timed(lambda: mapw.pair_rows(pairs), reps=args.reps)
     lanes, meta = mapw.pair_rows(pairs)
 
+    # device-side digest + one scalar sync (same methodology as the
+    # list wave bench: never time a full-batch device->host transfer)
+    import jax.numpy as jnp
+    from cause_tpu.parallel.wave import _digest_fn
+
+    jhi = jnp.asarray(lanes["hi"])
+    jlo = jnp.asarray(lanes["lo"])
+
     def kernel():
         o, r, v, _c_, ov = mapw.batched_merge_map_weave(lanes)
-        d = mapw.map_row_digest(lanes, r, v)
-        assert not bool(np.asarray(ov).any())
-        return int(d[0])
+        hs = jnp.take_along_axis(jhi, o, axis=1)
+        ls = jnp.take_along_axis(jlo, o, axis=1)
+        d = _digest_fn()(hs, ls, r, v)
+        assert not bool(np.asarray(ov.sum()))
+        return int(np.asarray(d[0]))
 
     t_kernel = timed(kernel, reps=args.reps)
     print(json.dumps({
