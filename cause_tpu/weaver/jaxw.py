@@ -554,12 +554,12 @@ def refresh_list_weave(ct):
 
 
 def merge_list_trees(ct1, ct2):
-    """Device-backed merge: union the node stores host-side, then one
-    batched reweave on device — O((n+m) log) instead of the reference's
-    O(n*m) reduce-insert, with an identical resulting tree."""
-    from ..collections import shared as s
-
-    return refresh_list_weave(s.union_nodes(ct1, ct2))
+    """Device-backed merge: union the node stores, then one batched
+    reweave on device — O((n+m) log) instead of the reference's O(n*m)
+    reduce-insert, with an identical resulting tree. Routes through
+    the N-way path, which unions cached lane views vectorized (one
+    packed-key argsort) when both trees carry them."""
+    return merge_many_list_trees((ct1, ct2))
 
 
 def merge_map_trees(ct1, ct2):
@@ -598,8 +598,11 @@ def merge_many_list_trees(cts):
     for ct in cts[1:]:
         s.check_mergeable(first, ct)
 
+    # earlier trees win the dict union so a conflict report's
+    # existing_node carries the body already in the merge target, not
+    # the incoming replica's (bodies only differ in the raising case)
     nodes = {}
-    for ct in cts:
+    for ct in reversed(cts):
         nodes.update(ct.nodes)
     for ct in cts:
         # C-speed subset test; on failure only, hunt the offender

@@ -147,3 +147,35 @@ def test_wave_merged_validates_conflicting_bodies():
     with pytest.raises(c.CausalError) as ei:
         res.merged(0)
     assert "append-only" in ei.value.info["causes"]
+
+
+def test_wave_works_for_sets_and_counters():
+    """Sets and counters are list-shaped trees: merge_wave converges
+    them like any list fleet."""
+    from cause_tpu.collections.ccounter import CausalCounter
+    from cause_tpu.collections.cset import CausalSet
+
+    sbase = c.cset("seed", weaver="jax")
+    spairs = []
+    for p in range(3):
+        a = CausalSet(sbase.ct.evolve(site_id=new_site_id())).add(f"a{p}")
+        b = CausalSet(sbase.ct.evolve(site_id=new_site_id())).discard(
+            "seed"
+        )
+        spairs.append((a, b))
+    res = merge_wave(spairs)
+    assert not res.fallback, "set wave demoted to the host path"
+    for i, (a, b) in enumerate(spairs):
+        assert res.merged(i).causal_to_edn() == a.merge(b).causal_to_edn()
+        assert res.merged(i).causal_to_edn() == {f"a{i}"}
+
+    cbase_ = c.ccounter(10, weaver="jax")
+    cpairs = []
+    for p in range(3):
+        a = CausalCounter(cbase_.ct.evolve(site_id=new_site_id())).increment(p)
+        b = CausalCounter(cbase_.ct.evolve(site_id=new_site_id())).decrement(1)
+        cpairs.append((a, b))
+    res = merge_wave(cpairs)
+    assert not res.fallback, "counter wave demoted to the host path"
+    for i, (a, b) in enumerate(cpairs):
+        assert res.merged(i).value() == a.merge(b).value() == 9 + i
