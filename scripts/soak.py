@@ -1,0 +1,207 @@
+"""Long-running differential soak: random CRDT op soups across every
+backend and the round-3 machinery (lane caches, incremental segments,
+waves, sessions, map forests), checked against the pure oracle after
+every step. Runs until --minutes elapses; any failure prints the
+(seed, round, step) repro triple and exits 1.
+
+Usage: python scripts/soak.py [--minutes 60] [--seed0 0]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401
+
+import argparse
+import os
+import random
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import cause_tpu as c  # noqa: E402
+from cause_tpu import K  # noqa: E402
+from cause_tpu.collections import clist as c_list  # noqa: E402
+from cause_tpu.collections.clist import CausalList  # noqa: E402
+from cause_tpu.collections.cmap import CausalMap  # noqa: E402
+from cause_tpu.ids import ROOT_ID, new_site_id  # noqa: E402
+from cause_tpu.parallel import merge_wave  # noqa: E402
+from cause_tpu.parallel.session import FleetSession  # noqa: E402
+from cause_tpu.weaver import lanecache, mapw  # noqa: E402
+from cause_tpu.weaver.arrays import NodeArrays  # noqa: E402
+from cause_tpu.weaver.segments import SEG_KEYS, tree_segments  # noqa: E402
+
+
+def check_view(ct):
+    view = ct.lanes
+    if view is None:
+        return
+    assert view.n == len(ct.nodes)
+    na_c = view.node_arrays()
+    na_f = NodeArrays.from_nodes_map(ct.nodes)
+    assert na_c.nodes == na_f.nodes
+    n = na_f.n
+    assert np.array_equal(na_c.cause_idx[:n], na_f.cause_idx[:n])
+    assert np.array_equal(na_c.vclass[:n], na_f.vclass[:n])
+    segs = view.arena.seg_cache.get(view.n)
+    if segs is not None:
+        hi, lo = na_c.id_lanes()
+        ref = tree_segments(hi, lo, na_c.cause_idx, na_c.vclass, n)
+        for key in SEG_KEYS:
+            assert np.array_equal(np.asarray(segs[key]),
+                                  np.asarray(ref[key])), key
+
+
+def list_round(rng):
+    cl = CausalList(c_list.weave(
+        c.clist(weaver="jax").extend(
+            [f"s{i}" for i in range(rng.randrange(1, 60))]
+        ).ct
+    ))
+    cl.ct.lanes.segments()
+    pure = CausalList(cl.ct.evolve(weaver="pure"))
+    fork = None
+    for step in range(rng.randrange(4, 25)):
+        op = rng.randrange(8)
+        if op == 0:
+            vals = [f"v{step}.{j}" for j in range(rng.randrange(1, 7))]
+            cl, pure = cl.extend(vals), pure.extend(vals)
+        elif op == 1:
+            cl, pure = cl.conj(f"c{step}"), pure.conj(f"c{step}")
+        elif op == 2:
+            cl, pure = cl.cons(f"f{step}"), pure.cons(f"f{step}")
+        elif op == 3 and len(cl.ct.weave) > 2:
+            target = rng.choice(cl.ct.weave[1:])[0]
+            cl = cl.append(target, c.hide)
+            pure = pure.append(target, c.hide)
+        elif op == 4:
+            fork = CausalList(
+                cl.ct.evolve(site_id=new_site_id())
+            ).extend([f"fk{step}"])
+        elif op == 5 and fork is not None:
+            cl = cl.merge(fork)
+            pure = CausalList(pure.merge(
+                CausalList(fork.ct.evolve(weaver="pure"))
+            ).ct.evolve(weaver="pure"))
+            fork = None
+        elif op == 6:
+            nid = (rng.randrange(0, 3), new_site_id(), 0)
+            node = (nid, ROOT_ID, f"mid{step}")
+            try:
+                cl, pure = cl.insert(node), pure.insert(node)
+            except c.CausalError:
+                pass
+        else:
+            blob = c.dumps(cl)
+            cl = c.loads(blob)
+        check_view(cl.ct)
+        assert c.causal_to_edn(cl) == c.causal_to_edn(pure), "render"
+
+
+def wave_round(rng):
+    n_base = rng.randrange(10, 80)
+    base = CausalList(c_list.weave(
+        c.clist(weaver="jax").extend(["w"] * n_base).ct
+    ))
+    base.ct.lanes.segments()
+    pairs = []
+    for p in range(rng.randrange(2, 6)):
+        a = CausalList(base.ct.evolve(site_id=new_site_id()))
+        b = CausalList(base.ct.evolve(site_id=new_site_id()))
+        for _ in range(rng.randrange(1, 5)):
+            a = a.conj(f"a{p}") if rng.random() < 0.5 else a.extend(
+                [f"ae{p}"]
+            )
+            b = b.conj(f"b{p}") if rng.random() < 0.5 else b.extend(
+                [f"be{p}"]
+            )
+        if rng.random() < 0.4:
+            b = b.append(list(b)[-1][0], c.hide)
+        pairs.append((a, b))
+    sess = FleetSession(pairs)
+    for rnd in range(rng.randrange(1, 4)):
+        d = sess.wave()
+        res = merge_wave(sess.pairs)
+        assert np.array_equal(d, res.digest), "session vs wave digest"
+        i = rng.randrange(len(pairs))
+        a, b = sess.pairs[i]
+        assert (c.causal_to_edn(sess.merged(i))
+                == c.causal_to_edn(a.merge(b))), "materialization"
+        nxt = []
+        for a, b in sess.pairs:
+            if rng.random() < 0.3 and len(list(a)) > 1:
+                a = a.append(rng.choice(list(a))[0], c.hide)
+            else:
+                a = a.conj("x")
+            nxt.append((a, b.extend(["y"])))
+        sess.update(nxt)
+
+
+def map_round(rng):
+    base = c.cmap()
+    keys = [K(f"k{i}") for i in range(rng.randrange(2, 8))]
+    for k in keys:
+        base = base.append(k, "v")
+    pairs = []
+    for p in range(rng.randrange(2, 5)):
+        a = CausalMap(base.ct.evolve(site_id=new_site_id()))
+        b = CausalMap(base.ct.evolve(site_id=new_site_id()))
+        for _ in range(rng.randrange(1, 6)):
+            ka = rng.choice(keys + [K(f"n{p}")])
+            a = a.dissoc(ka) if rng.random() < 0.25 else a.append(
+                ka, f"a{p}"
+            )
+            kb = rng.choice(keys)
+            b = b.append(kb, f"b{p}")
+        if rng.random() < 0.4:
+            k0 = rng.choice([k_ for k_ in keys if a.ct.weave.get(k_)])
+            target = a.ct.weave[k0][1][0]
+            a = a.append(target, c.hide)
+        pairs.append((a, b))
+    lanes, meta = mapw.pair_rows([(x.ct.nodes, y.ct.nodes)
+                                  for x, y in pairs])
+    o, r, v, _c_, ov = mapw.batched_merge_map_weave(lanes)
+    assert not bool(np.asarray(ov).any())
+    for i, (x, y) in enumerate(pairs):
+        got = mapw.merged_map_weave(lanes, meta, np.asarray(o),
+                                    np.asarray(r), i)
+        ref = x.merge(y).ct.weave
+        for k in ref:
+            assert got[k] == ref[k], ("map", i, k)
+
+
+ROUNDS = (list_round, wave_round, map_round)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=60.0)
+    ap.add_argument("--seed0", type=int, default=0)
+    args = ap.parse_args()
+    deadline = time.monotonic() + args.minutes * 60
+    seed = args.seed0
+    done = 0
+    while time.monotonic() < deadline:
+        rng = random.Random(seed)
+        kind = ROUNDS[seed % len(ROUNDS)]
+        try:
+            kind(rng)
+        except Exception as e:  # noqa: BLE001 - repro logging
+            print(f"SOAK FAILURE seed={seed} kind={kind.__name__}: "
+                  f"{type(e).__name__}: {e}", flush=True)
+            raise
+        seed += 1
+        done += 1
+        if done % 25 == 0:
+            print(f"soak: {done} rounds clean (seed {seed})", flush=True)
+    print(f"soak finished: {done} rounds clean, no failures", flush=True)
+
+
+if __name__ == "__main__":
+    main()
