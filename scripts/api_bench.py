@@ -76,13 +76,18 @@ def wave_bench(args):
     ))
     pairs = []
     for p in range(B):
-        a = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
-            [f"a{p}.{i}" for i in range(n_div)]
-        )
-        b = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
-            [f"b{p}.{i}" for i in range(n_div)]
-        )
-        pairs.append((a, b))
+        # BASELINE config-5 shape: divergent suffixes with a tombstone
+        # every 8th node (tombstones break chain runs, so this is the
+        # honest segment/token structure, not a best case)
+        def replica(tag):
+            r = CausalList(base.ct.evolve(site_id=new_site_id()))
+            vals = [f"{tag}{p}.{i}" for i in range(n_div)]
+            for start in range(0, n_div, 8):
+                r = r.extend(vals[start:start + 8])
+                r = r.append(list(r.ct.weave[-1:])[0][0], c.hide)
+            return r
+
+        pairs.append((replica("a"), replica("b")))
     build_s = time.perf_counter() - t0
     print(json.dumps({
         "metric": "wave setup (mint replicas, incl. incremental lane cache)",
