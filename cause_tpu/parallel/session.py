@@ -109,6 +109,9 @@ class FleetSession:
                                 {"causes": {"empty-fleet"}})
         for a, b in pairs:
             s.check_mergeable(a.ct, b.ct)
+        # map trees (rejected by view_for — they need the mapw forest
+        # encoding) and off-domain ids surface as the outside-domain
+        # raise from the first _full_upload
         self.d_max = int(d_max)
         self._bufs = WaveBuffers()
         self._views: List[Tuple[object, object]] = []
@@ -146,8 +149,11 @@ class FleetSession:
         from ..benchgen import v5_token_budget
 
         u = v5_token_budget(lanes)
-        self.u_max = max(self.u_max,
-                         int(u * self._u_headroom) + self.d_max)
+        # pow2-quantized (stable XLA program shapes across sessions
+        # and re-uploads)
+        self.u_max = max(self.u_max, next_pow2(
+            int(u * self._u_headroom) + self.d_max
+        ))
         self.capacity = cap
         self.dev = {k: jnp.asarray(v) for k, v in lanes.items()}
         self._views = views

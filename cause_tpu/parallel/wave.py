@@ -249,6 +249,9 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
     views: List[Optional[Tuple[object, object]]] = []
     fallback = {}
     for i, (a, b) in enumerate(pairs):
+        # view_for returns None for map trees (they need the mapw
+        # forest encoding) and off-domain ids: both take the correct
+        # per-pair host merge below
         va = lanecache.view_for(a.ct)
         vb = lanecache.view_for(b.ct)
         if va is not None and vb is not None and not lanecache.compatible(
@@ -283,7 +286,10 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
 
     from ..benchgen import LANE_KEYS5, v5_token_budget
 
-    u_max = v5_token_budget(lanes)
+    # pow2-quantized budget: every distinct u_max is a distinct XLA
+    # program, so exact budgets would recompile on every wave whose
+    # divergence shifted slightly
+    u_max = next_pow2(v5_token_budget(lanes))
     if mesh is not None:
         from .mesh import sharded_merge_weave_v5
 

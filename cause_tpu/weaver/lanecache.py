@@ -470,9 +470,31 @@ def extend_view(view: Optional[LaneView], new_nodes) -> Optional[LaneView]:
     return LaneView(arena, n + k)
 
 
+def _list_shaped_types():
+    """Tree types whose lanes ARE list lanes (maps need the key-rooted
+    forest encoding of weaver.mapw instead). Derived from the type
+    constants so a rename can't silently diverge."""
+    from ..collections.ccounter import COUNTER_TYPE
+    from ..collections.cset import SET_TYPE
+    from ..collections.shared import LIST_TYPE
+
+    return frozenset((LIST_TYPE, SET_TYPE, COUNTER_TYPE))
+
+
+LIST_SHAPED: frozenset = None  # populated lazily (import-cycle safety)
+
+
 def view_for(ct) -> Optional[LaneView]:
-    """The tree's cached view if fresh, else a new build (list trees
-    only). None when the tree is outside the cacheable domain."""
+    """The tree's cached view if fresh, else a new build — LIST-SHAPED
+    trees only: a map tree through these lanes would mint a
+    list-semantics weave, so it returns None and callers take their
+    fallback/mapw path. None also when the tree is outside the
+    cacheable domain (PackSpec overflow)."""
+    global LIST_SHAPED
+    if LIST_SHAPED is None:
+        LIST_SHAPED = _list_shaped_types()
+    if ct.type not in LIST_SHAPED:
+        return None
     view = getattr(ct, "lanes", None)
     if isinstance(view, LaneView) and view.n == len(ct.nodes):
         return view

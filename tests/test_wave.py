@@ -179,3 +179,25 @@ def test_wave_works_for_sets_and_counters():
     assert not res.fallback, "counter wave demoted to the host path"
     for i, (a, b) in enumerate(cpairs):
         assert res.merged(i).value() == a.merge(b).value() == 9 + i
+
+
+def test_wave_routes_maps_to_the_correct_path():
+    """CausalMap pairs must NOT ride the list-lane wave (their weave is
+    a per-key dict; list lanes would mint a list-semantics weave) —
+    they fall back to the correct per-pair merge, and FleetSession
+    rejects them outright (regression: merged() returned a CausalMap
+    whose weave was a list)."""
+    from cause_tpu import K
+    from cause_tpu.collections.cmap import CausalMap
+    from cause_tpu.parallel.session import FleetSession
+
+    base = c.cmap().append(K("t"), "x")
+    a = CausalMap(base.ct.evolve(site_id=new_site_id())).append(K("t"), "a")
+    b = CausalMap(base.ct.evolve(site_id=new_site_id())).append(K("u"), "b")
+    res = merge_wave([(a, b)])
+    assert res.fallback == [0]
+    m = res.merged(0)
+    assert isinstance(m.ct.weave, dict)
+    assert c.causal_to_edn(m) == c.causal_to_edn(a.merge(b))
+    with pytest.raises(c.CausalError):
+        FleetSession([(a, b)])
