@@ -289,6 +289,21 @@ def check_mergeable(ct1: CausalTree, ct2: CausalTree) -> None:
         )
 
 
+def check_no_conflicting_bodies(nodes: dict, other: dict) -> None:
+    """The append-only union validation every merge path shares: a
+    duplicate id whose body differs raises, reporting the body already
+    in ``nodes`` (the merge target's side). C-speed on the common case
+    via the set-algebra membership test."""
+    common = nodes.keys() & other.keys()
+    for nid in common:
+        if nodes[nid] != other[nid]:
+            raise CausalError(
+                "This node is already in the tree and can't be changed.",
+                {"causes": {"append-only", "edits-not-allowed"},
+                 "existing_node": (nid,) + nodes[nid]},
+            )
+
+
 def union_nodes(ct1: CausalTree, ct2: CausalTree) -> CausalTree:
     """The host half of every accelerated merge: guard, union the node
     stores (append-only conflict check, as in ``insert``), fast-forward

@@ -198,18 +198,10 @@ class WaveResult:
         weave = [node_at(int(j)) for j in order]
         union = lanecache.union_views(va, vb)
         nodes = dict(a.ct.nodes)
-        # append-only body validation, C-speed set algebra (the same
-        # check a.merge(b) runs): a duplicate id with a different body
-        # must raise, never yield a weave/nodes-inconsistent tree
-        common = nodes.keys() & b.ct.nodes.keys()
-        for nid in common:
-            if nodes[nid] != b.ct.nodes[nid]:
-                raise s.CausalError(
-                    "This node is already in the tree and can't be "
-                    "changed.",
-                    {"causes": {"append-only", "edits-not-allowed"},
-                     "existing_node": (nid,) + nodes[nid]},
-                )
+        # the same append-only validation a.merge(b) runs: a duplicate
+        # id with a different body must raise, never yield a
+        # weave/nodes-inconsistent tree
+        s.check_no_conflicting_bodies(nodes, b.ct.nodes)
         nodes.update(b.ct.nodes)
         yarns = {}
         if union is not None:

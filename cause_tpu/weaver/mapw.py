@@ -55,6 +55,8 @@ __all__ = [
     "sharded_merge_map_weave",
     "merged_map_weave",
     "map_row_digest",
+    "MapWaveResult",
+    "merge_map_wave",
 ]
 
 GLOBAL_ROOT_HI = np.int32(-2)
@@ -102,6 +104,15 @@ def forest_lanes(nodes_map: dict, key_rank: Dict[object, int],
     n = 1 + n_keys + len(ids)
     if n > cap:
         raise OverflowError(f"capacity {cap} < {n} forest lanes")
+    if ids:
+        # ids beyond the PackSpec bit layout would silently wrap the
+        # packed lo lane and reorder the merge — same off-device stance
+        # as NodeArrays.from_nodes_map
+        try:
+            spec.check(max(i[0] for i in ids), len(interner),
+                       max(i[2] for i in ids))
+        except OverflowError:
+            raise OutsideDomain() from None
 
     hi = np.full(cap, I32_MAX, np.int32)
     lo = np.full(cap, I32_MAX, np.int32)
@@ -293,3 +304,159 @@ def map_row_digest(lanes, rank, visible):
            + np.uint32(1))
     )
     return np.where(keptm, mix, np.uint32(0)).sum(axis=1, dtype=np.uint32)
+
+
+class MapWaveResult:
+    """Converged device state of a map-fleet wave + lazy host
+    materialization (the map twin of parallel.wave.WaveResult)."""
+
+    def __init__(self, pairs, lanes, meta, order, rank, visible, digest,
+                 fallback=None, digest_valid=None):
+        self._pairs = pairs
+        self._lanes = lanes
+        self._meta = meta
+        self._order = order
+        self._rank = rank
+        self._visible = visible
+        self.digest = digest
+        self._fallback = fallback or {}
+        self.digest_valid = (
+            digest_valid if digest_valid is not None
+            else np.ones(len(pairs), bool)
+        )
+
+    @property
+    def fallback(self):
+        return sorted(self._fallback)
+
+    def __len__(self):
+        return len(self._pairs)
+
+    def merged(self, i: int):
+        """Pair ``i``'s converged CausalMap handle — identical to
+        ``pairs[i][0].merge(pairs[i][1])`` (with the same append-only
+        body validation)."""
+        from ..collections import shared as s
+
+        if i in self._fallback:
+            return self._fallback[i]
+        a, b = self._pairs[i]
+        nodes = dict(a.ct.nodes)
+        s.check_no_conflicting_bodies(nodes, b.ct.nodes)
+        nodes.update(b.ct.nodes)
+        weave = merged_map_weave(self._lanes, self._meta, self._order,
+                                 self._rank, i)
+        lamport = max(
+            a.ct.lamport_ts, b.ct.lamport_ts,
+            max((nid[0] for nid in nodes), default=0),
+        )
+        ct = s.spin(a.ct.evolve(nodes=nodes, weave=weave,
+                                lamport_ts=lamport))
+        return type(a)(ct)
+
+
+def merge_map_wave(pairs) -> MapWaveResult:
+    """Converge many CausalMap replica pairs in one batched device
+    dispatch — the map twin of ``parallel.merge_wave`` (map trees
+    cannot ride the list-lane wave; their forest encoding lives here).
+    Pairs outside the forest domain (exotic id-cause chains, weft
+    gibberish, PackSpec overflow) fall back to the per-pair host merge
+    exactly like the list wave's fallback. Body validation between
+    duplicate ids is host-side in ``merged``, same contract."""
+    from ..collections import shared as s
+
+    pairs = list(pairs)
+    if not pairs:
+        raise s.CausalError("Nothing to merge.",
+                            {"causes": {"empty-fleet"}})
+    for a, b in pairs:
+        s.check_mergeable(a.ct, b.ct)
+        if a.ct.type != "map":
+            raise s.CausalError(
+                "merge_map_wave is for map trees; use "
+                "parallel.merge_wave for list-shaped fleets",
+                {"causes": {"type-missmatch"}, "type": a.ct.type},
+            )
+
+    # batch-level key/site tables cover every tree (fallback pairs
+    # included: extra entries cost rank space, not correctness)
+    trees = [t.ct.nodes for pair in pairs for t in pair]
+    krank = key_table(trees)
+    interner = SiteInterner(nid[1] for t in trees for nid in t)
+    cap = next_pow2(max(1 + len(krank) + len(t) for t in trees))
+    fallback = {}
+    live = []
+    live_rows = []
+    for i, (a, b) in enumerate(pairs):
+        try:
+            row = [forest_lanes(a.ct.nodes, krank, interner, cap),
+                   forest_lanes(b.ct.nodes, krank, interner, cap)]
+        except OutsideDomain:
+            fallback[i] = a.merge(b)
+            continue
+        live.append(i)
+        live_rows.append(row)
+
+    B = len(pairs)
+    dig_valid = np.zeros(B, bool)
+    digest = np.zeros(B, np.uint32)
+    if not live:
+        return MapWaveResult(pairs, None, {"rows": [], "capacity": cap},
+                             None, None, None, digest, fallback,
+                             dig_valid)
+    N = 2 * cap
+    lanes = {
+        "hi": np.full((len(live), N), I32_MAX, np.int32),
+        "lo": np.full((len(live), N), I32_MAX, np.int32),
+        "cci": np.full((len(live), N), -1, np.int32),
+        "vc": np.zeros((len(live), N), np.int32),
+        "valid": np.zeros((len(live), N), bool),
+    }
+    meta_rows = []
+    for r, row in enumerate(live_rows):
+        rm = []
+        for t, (hi, lo, cci, vc, valid, lane_nodes, lane_keys) in enumerate(
+                row):
+            sl = slice(t * cap, (t + 1) * cap)
+            lanes["hi"][r, sl] = hi
+            lanes["lo"][r, sl] = lo
+            lanes["cci"][r, sl] = np.where(cci >= 0, cci + t * cap, -1)
+            lanes["vc"][r, sl] = vc
+            lanes["valid"][r, sl] = valid
+            rm.append((lane_nodes, lane_keys))
+        meta_rows.append(rm)
+    meta = {"rows": meta_rows, "capacity": cap, "key_rank": krank}
+
+    order, rank, visible, _conflict, overflow = batched_merge_map_weave(
+        lanes
+    )
+    if bool(np.asarray(overflow).any()):  # pragma: no cover - k_max=N
+        raise s.CausalError("map wave overflowed its run budget",
+                            {"causes": {"token-overflow"}})
+    order = np.asarray(order)
+    rank = np.asarray(rank)
+    visible = np.asarray(visible)
+    live_digest = map_row_digest(lanes, rank, visible)
+
+    # expand live rows back to the full index space
+    full_order = np.zeros((B, N), np.int32)
+    full_rank = np.full((B, N), N, np.int32)
+    full_vis = np.zeros((B, N), bool)
+    full_meta = [None] * B
+    for j, i in enumerate(live):
+        full_order[i] = order[j]
+        full_rank[i] = rank[j]
+        full_vis[i] = visible[j]
+        full_meta[i] = meta_rows[j]
+        digest[i] = live_digest[j]
+        dig_valid[i] = True
+    # merged_map_weave indexes meta["rows"][i] and the full arrays
+    full_lanes = {
+        k: np.zeros((B,) + v.shape[1:], v.dtype) for k, v in lanes.items()
+    }
+    for j, i in enumerate(live):
+        for k in full_lanes:
+            full_lanes[k][i] = lanes[k][j]
+    meta_full = {"rows": full_meta, "capacity": cap, "key_rank": krank}
+    return MapWaveResult(pairs, full_lanes, meta_full, full_order,
+                         full_rank, full_vis, digest, fallback, dig_valid)

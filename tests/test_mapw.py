@@ -127,3 +127,71 @@ def test_map_fuzz_batched_parity():
         assert not bool(np.asarray(ov).any())
         for i in range(len(pairs)):
             assert_row_matches_pure(pairs, lanes, meta, order, rank, i)
+
+
+def test_merge_map_wave_api():
+    """The API-level map wave: one dispatch, digests, lazy handles —
+    identical results to pairwise merges."""
+    pairs = make_pairs(5)
+    res = mapw.merge_map_wave(pairs)
+    assert len(set(res.digest.tolist())) == len(pairs)
+    for i, (a, b) in enumerate(pairs):
+        got = res.merged(i)
+        ref = a.merge(b)
+        assert c.causal_to_edn(got) == c.causal_to_edn(ref), i
+        assert got.ct.weave == ref.ct.weave
+        assert got.get_nodes() == ref.get_nodes()
+    # guards: list handles are rejected, conflicts raise at merged()
+    with pytest.raises(c.CausalError):
+        mapw.merge_map_wave([(c.clist("x"), c.clist("x"))])
+    a, b = pairs[0]
+    evil = (99, a.get_site_id(), 0)
+    a2 = a.insert((evil, K("k0"), "mine"))
+    b2 = b.insert((evil, K("k0"), "theirs"))
+    res2 = mapw.merge_map_wave([(a2, b2)])
+    with pytest.raises(c.CausalError) as ei:
+        res2.merged(0)
+    assert "append-only" in ei.value.info["causes"]
+
+
+def test_merge_map_wave_edge_cases():
+    """Review-found edges: empty maps materialize; out-of-domain pairs
+    (h.show targeting a hide) fall back per pair instead of killing
+    the wave; PackSpec overflow falls back rather than silently
+    wrapping packed ids."""
+    # empty pair
+    m = c.cmap()
+    m2 = fork(m)
+    res = mapw.merge_map_wave([(m, m2)])
+    assert c.causal_to_edn(res.merged(0)) == c.causal_to_edn(m.merge(m2))
+
+    # out-of-domain: h.show caused by a hide node (id-caused targeting
+    # id-caused), which the pure weaver accepts
+    from cause_tpu.ids import HIDE, H_SHOW
+
+    a = c.cmap().append(K("k"), "v1")
+    target = a.ct.weave[K("k")][1][0]
+    a = a.append(target, c.hide)
+    hide_id = next(nid for nid, (_cz, v) in a.ct.nodes.items()
+                   if v is HIDE)
+    a = a.insert(((a.get_ts() + 1, a.get_site_id(), 0), hide_id, H_SHOW))
+    b = fork(a).append(K("x"), 1)
+    good = fork(a).append(K("y"), 2)
+    res = mapw.merge_map_wave([(a, b), (good, fork(good))])
+    assert 0 in res.fallback
+    for i, (x, y) in enumerate([(a, b), (good, fork(good))][:1]):
+        assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(
+            x.merge(y)
+        )
+
+    # PackSpec overflow (huge ts) falls back, result still correct
+    big = ((1 << 31) - 1, a.get_site_id(), 0)
+    o1 = c.cmap().append(K("t"), 1)
+    o1b = fork(o1)
+    o1 = o1.insert((big, K("t"), "huge"))
+    o1b = o1b.insert((big, K("t"), "huge"))
+    res = mapw.merge_map_wave([(o1, o1b)])
+    assert res.fallback == [0]
+    assert c.causal_to_edn(res.merged(0)) == c.causal_to_edn(
+        o1.merge(o1b)
+    )
