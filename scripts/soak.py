@@ -128,7 +128,17 @@ def wave_round(rng):
     for rnd in range(rng.randrange(1, 4)):
         d = sess.wave()
         res = merge_wave(sess.pairs)
-        assert np.array_equal(d, res.digest), "session vs wave digest"
+        # digests compare only where the wave computed one on device:
+        # a row outside merge_wave's sampled token budget legitimately
+        # falls back (digest_valid False) while the session's larger
+        # headroom budget still runs it on device
+        assert np.array_equal(d[res.digest_valid],
+                              res.digest[res.digest_valid]), \
+            "session vs wave digest"
+        for i in res.fallback:
+            a, b = sess.pairs[i]
+            assert (c.causal_to_edn(sess.merged(i))
+                    == c.causal_to_edn(a.merge(b))), "fallback row"
         i = rng.randrange(len(pairs))
         a, b = sess.pairs[i]
         assert (c.causal_to_edn(sess.merged(i))
