@@ -278,6 +278,19 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
 
     from ..benchgen import LANE_KEYS5, v5_token_budget
 
+    def dispatch_v5(sub_lanes, u):
+        """Batched v5 + device digest, one scalar-free host fetch."""
+        from ..weaver.jaxw5 import batched_merge_weave_v5
+
+        r, v, _c, ov = batched_merge_weave_v5(
+            *(jnp.asarray(sub_lanes[k]) for k in LANE_KEYS5),
+            u_max=u, k_max=u,
+        )
+        d = _digest_fn()(jnp.asarray(sub_lanes["hi"]),
+                         jnp.asarray(sub_lanes["lo"]), r, v)
+        return (np.asarray(r), np.asarray(v), np.asarray(d),
+                np.asarray(ov))
+
     # pow2-quantized budget: every distinct u_max is a distinct XLA
     # program, so exact budgets would recompile on every wave whose
     # divergence shifted slightly
@@ -294,18 +307,24 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
         digest = np.asarray(digest)
         overflow = np.asarray(overflow)
     else:
-        from ..weaver.jaxw5 import batched_merge_weave_v5
-
-        r, v, _c, ov = batched_merge_weave_v5(
-            *(jnp.asarray(lanes[k]) for k in LANE_KEYS5),
-            u_max=u_max, k_max=u_max,
-        )
-        digest = np.asarray(
-            _digest_fn()(jnp.asarray(lanes["hi"]), jnp.asarray(lanes["lo"]),
-                         r, v)
-        )
-        rank, visible = np.asarray(r), np.asarray(v)
-        overflow = np.asarray(ov)
+        rank, visible, digest, overflow = dispatch_v5(lanes, u_max)
+    if overflow.any():
+        # the token budget samples rows; a spiky unsampled row can
+        # overflow. Retry just those rows (unsharded — a handful of
+        # rows doesn't need the mesh) with a doubled budget before
+        # resorting to host merges. np.array: jax host buffers can be
+        # read-only.
+        rows = np.flatnonzero(overflow)
+        sub = {k: lanes[k][rows] for k in LANE_KEYS5}
+        r2, v2, d2, ov2 = dispatch_v5(sub, 2 * u_max)
+        rank = np.array(rank)
+        visible = np.array(visible)
+        digest = np.array(digest)
+        overflow = np.array(overflow)
+        rank[rows] = r2
+        visible[rows] = v2
+        digest[rows] = d2
+        overflow[rows] = ov2
 
     B = len(pairs)
     full_rank = np.full((B, 2 * cap), 2 * cap, np.int32)

@@ -201,3 +201,20 @@ def test_wave_routes_maps_to_the_correct_path():
     assert c.causal_to_edn(m) == c.causal_to_edn(a.merge(b))
     with pytest.raises(c.CausalError):
         FleetSession([(a, b)])
+
+
+def test_wave_overflow_rows_retry_on_device():
+    """A spiky row outside the sampled token budget retries with a
+    doubled budget instead of silently demoting to the host merge
+    (soak-found: session digests diverged from wave digests purely
+    because of budget-sampling fallbacks)."""
+    pairs = make_pairs(5, n_base=40, n_div=6)
+    a, b = pairs[2]
+    for j in range(12):  # interior tombstones explode pair 2's segments
+        a = a.append(list(a)[2 + j][0], c.hide)
+    pairs[2] = (a, b)
+    res = merge_wave(pairs)
+    assert not res.fallback
+    assert res.digest_valid.all()
+    for i, (x, y) in enumerate(pairs):
+        assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(x.merge(y))
