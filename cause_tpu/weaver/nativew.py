@@ -59,16 +59,33 @@ def _inverse_permutation(rank: np.ndarray) -> np.ndarray:
 
 def refresh_list_weave(ct):
     """Full list-weave rebuild through the native linearizer; identical
-    output to the pure replay (falls back to it off-domain)."""
+    output to the pure replay (falls back to it off-domain). Reuses —
+    and attaches — the persistent lane cache when the tree is inside
+    its domain, so native trees share the incremental-marshal benefits
+    (PackSpec-overflowing ids keep the direct marshal: the native
+    linearizer needs no packed lanes)."""
     from ..collections import clist as c_list
+    from . import lanecache
 
+    # PackSpec-overflowing trees (view None) re-marshal via
+    # _list_lanes — a second O(n) pass, accepted: the native linearizer
+    # works beyond the packed-id domain and such trees are rare corners
+    view = lanecache.view_for(ct)
     try:
-        nodes, cause_idx, vclass = _list_lanes(ct.nodes)
+        if view is not None:
+            a, n = view.arena, view.n
+            nodes = a.nodes[:n]
+            cause_idx = a.cause_idx[:n]
+            vclass = a.vclass[:n]
+            if n > 1 and (cause_idx[1:] < 0).any():
+                raise _OutsideDomain()  # dangling causes (weft gibberish)
+        else:
+            nodes, cause_idx, vclass = _list_lanes(ct.nodes)
         rank = native.weave_list_ranks(cause_idx, vclass)
     except (RuntimeError, _OutsideDomain):
         return c_list.weave(ct.evolve(weaver="pure")).evolve(weaver=ct.weaver)
     order = _inverse_permutation(rank)
-    return ct.evolve(weave=[nodes[i] for i in order])
+    return ct.evolve(weave=[nodes[i] for i in order], lanes=view)
 
 
 def refresh_map_weave(ct):
