@@ -186,7 +186,56 @@ def map_round(rng):
             assert got[k] == ref[k], ("map", i, k)
 
 
-ROUNDS = (list_round, wave_round, map_round)
+def base_round(rng):
+    """CausalBase soup: nested maps/lists/sets/counters, random
+    transactions, undo/redo walks, serde round-trips, replica sync."""
+    from cause_tpu import cbase as b
+    from cause_tpu import serde, sync
+    from cause_tpu.collections.ccounter import CausalCounter
+    from cause_tpu.collections.cset import CausalSet
+
+    cb = b.transact_(b.new_cb(), [[None, None, {
+        K("doc"): ["hello", {K("meta"): "m"}],
+        K("tags"): {"a", "b"},
+        K("votes"): c.ccounter(rng.randrange(0, 9)),
+    }]])
+    undone = 0
+    for step in range(rng.randrange(4, 16)):
+        op = rng.randrange(6)
+        try:
+            if op == 0:
+                set_uuid = next(u for u, h in cb.collections.items()
+                                if isinstance(h, CausalSet))
+                cb = b.transact_(cb, [[set_uuid, None,
+                                       {f"t{step}", f"u{step}"}]])
+            elif op == 1:
+                ctr_uuid = next(u for u, h in cb.collections.items()
+                                if isinstance(h, CausalCounter))
+                cb = b.transact_(cb, [[ctr_uuid, c.root_id,
+                                       rng.randrange(-3, 4) or 1]])
+            elif op == 2:
+                cb = b.transact_(cb, [[cb.root_uuid, K(f"k{step}"),
+                                       [step, str(step)]]])
+            elif op == 3 and cb.history:
+                cb = b.undo_(cb)
+                undone += 1
+            elif op == 4 and undone:
+                cb = b.redo_(cb)
+                undone -= 1
+            else:
+                cb = serde.loads(serde.dumps(b.CausalBase(cb))).cb
+        except c.CausalError:
+            pass  # guards (nothing-to-undo etc.) are legal outcomes
+        b.cb_to_edn(cb)  # must always render
+    ra = b.CausalBase(cb.evolve(site_id="siteA________"))
+    rb = b.CausalBase(cb.evolve(site_id="siteB________"))
+    ra = b.CausalBase(b.transact_(ra.cb, [[ra.cb.root_uuid, K("ra"), 1]]))
+    rb = b.CausalBase(b.transact_(rb.cb, [[rb.cb.root_uuid, K("rb"), 2]]))
+    sa, sb = sync.sync_base_pair(ra, rb)
+    assert b.cb_to_edn(sa.cb) == b.cb_to_edn(sb.cb), "base sync diverged"
+
+
+ROUNDS = (list_round, wave_round, map_round, base_round)
 
 
 def main():
