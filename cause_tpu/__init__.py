@@ -193,6 +193,25 @@ from .sync import (  # noqa: E402
     version_vector,
 )
 
+# Fleet-scale device APIs, lazily re-exported (PEP 562) so importing
+# cause_tpu never drags jax/mesh machinery into pure-host users.
+_FLEET_EXPORTS = {
+    "merge_wave": "cause_tpu.parallel",
+    "FleetSession": "cause_tpu.parallel",
+    "WaveResult": "cause_tpu.parallel",
+    "WaveBuffers": "cause_tpu.parallel",
+    "merge_map_wave": "cause_tpu.weaver.mapw",
+}
+
+
+def __getattr__(name):
+    mod = _FLEET_EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module 'cause_tpu' has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod), name)
+
 __all__ = [
     "CausalBase",
     "CausalError",
@@ -249,6 +268,9 @@ __all__ = [
     "sync_pair",
     "sync_stream",
     "version_vector",
+    "merge_wave",
+    "merge_map_wave",
+    "FleetSession",
     "is_special",
     "new_uid",
     "new_site_id",
