@@ -40,7 +40,7 @@ from .ids import (
     node,
 )
 
-__version__ = "0.2.0"
+__version__ = "0.3.0"
 
 # Special values have special effects on causal collections.
 # NOTE: specials do not compose — applying hide to a hide is not a show
