@@ -133,6 +133,15 @@ def tree_segments(hi, lo, cause_idx, vclass, n: int) -> Dict[str, np.ndarray]:
     dense_ok = dense_hi | dense_lo
     dense_ok[0] = True
     glued &= dense_ok
+    # the root is always a singleton segment (its packed lo differs
+    # from any chain site's, so a root-headed run could never be
+    # dense). This must precede the alternation cut: the cut reads
+    # glued[1], and the pre-singleton value depends on whether the
+    # ROOT is contested — which later root-caused lanes flip, making
+    # old segment boundaries depend on the tree's future (raw fuzz
+    # caught exactly that prefix instability).
+    if n > 1:
+        glued[1] = False
     # dedupe soundness: a dense run's member ids must be fully
     # determined by (min, max, len), which holds only when the whole
     # run follows ONE pattern (for len > 1 the endpoints reveal which:
@@ -142,10 +151,6 @@ def tree_segments(hi, lo, cause_idx, vclass, n: int) -> Dict[str, np.ndarray]:
         alt = np.zeros(n, bool)
         alt[2:] = glued[2:] & glued[1:-1] & (dense_lo[2:] != dense_lo[1:-1])
         glued &= ~alt
-    # the root is always a singleton segment (its packed lo differs
-    # from any chain site's, so a root-headed run could never be dense)
-    if n > 1:
-        glued[1] = False
 
     run_start = ~glued
     rid = np.cumsum(run_start).astype(np.int32) - 1

@@ -276,3 +276,95 @@ def test_incremental_segments_fuzz():
                 cl = cl.merge(fork)
             assert_segments_match_scratch(cl.ct)
             assert_view_matches_scratch(cl.ct)
+
+
+@pytest.mark.slow
+def test_extend_segments_raw_adversarial():
+    """Raw-lane fuzz of segments.extend_segments: synthetic id/cause/
+    vclass lanes (mixed dense patterns, special chains, boundary
+    tombstones, root stabs) extended in random slices — every accepted
+    extension must equal from-scratch tree_segments; bails are always
+    allowed, silent divergence never."""
+    from cause_tpu.weaver.arrays import DEFAULT_PACK
+    from cause_tpu.weaver.segments import (
+        SEG_KEYS, extend_segments, tree_segments,
+    )
+
+    rng = random.Random(1234)
+    spec = DEFAULT_PACK
+    n_accepted = 0
+    for round_ in range(120):
+        # build a synthetic tree lane-by-lane in id order: anything
+        # goes in the old prefix; the appended suffix leans toward
+        # append shapes (chain/tx-run/tail-tombstone/root-cons) so the
+        # extension path actually runs, with occasional stabs to pin
+        # the bail
+        n_total = rng.randrange(6, 60)
+        n_old = rng.randrange(2, n_total)
+        ts = [0]
+        site = [0]
+        tx = [0]
+        vclass = [0]
+        cause = [-1]
+        cur_ts = 0
+        for i in range(1, n_total):
+            in_suffix = i >= n_old
+            style = rng.randrange(10 if not in_suffix else 12)
+            if style < 5:  # conj chain (hi+1)
+                cur_ts += 1
+                ts.append(cur_ts)
+                site.append(site[-1] if rng.random() < 0.8 else
+                            rng.randrange(3))
+                tx.append(0)
+                cause.append(i - 1)
+            elif style < 8:  # tx run (lo+1)
+                ts.append(ts[-1] if tx[-1] < 100 and i > 1 else cur_ts)
+                site.append(site[-1])
+                tx.append(tx[-1] + 1 if ts[-1] == ts[-2 if i > 1 else -1]
+                          else 0)
+                cause.append(i - 1)
+            elif style < 9 or not in_suffix:  # stab earlier lane/root
+                cur_ts += 1
+                ts.append(cur_ts)
+                site.append(rng.randrange(3))
+                tx.append(0)
+                cause.append(rng.randrange(0, i))
+            elif style < 11:  # suffix: hang on the old tail / root
+                cur_ts += 1
+                ts.append(cur_ts)
+                site.append(site[-1])
+                tx.append(0)
+                cause.append(n_old - 1 if style == 9 else 0)
+            else:  # suffix: tombstone of the previous lane
+                cur_ts += 1
+                ts.append(cur_ts)
+                site.append(site[-1])
+                tx.append(0)
+                cause.append(i - 1)
+                vclass.append(1)
+                continue
+            vclass.append(rng.choice((0, 0, 0, 1, 2)))
+        ts = np.array(ts, np.int64)
+        site = np.array(site, np.int64)
+        tx = np.array(tx, np.int64)
+        vclass = np.array(vclass, np.int32)
+        cause_idx = np.array(cause, np.int32)
+        hi = ts.astype(np.int32)
+        lo = spec.pack_lo(site.astype(np.int32), tx.astype(np.int32))
+
+        old = tree_segments(hi, lo, cause_idx, vclass, n_old)
+        lo_win = lo[n_old - 1:n_total]
+        got = extend_segments(old, hi, lo_win, cause_idx, vclass,
+                              n_old, n_total)
+        if got is None:
+            continue  # bail is always legal
+        n_accepted += 1
+        ref = tree_segments(hi, lo, cause_idx, vclass, n_total)
+        for key in SEG_KEYS:
+            assert np.array_equal(np.asarray(got[key]),
+                                  np.asarray(ref[key])), (round_, key)
+        assert np.array_equal(got["run_of_lane"][:n_total],
+                              ref["run_of_lane"][:n_total]), round_
+    assert n_accepted >= 20, (
+        f"fuzz exercised only {n_accepted} extensions — generator drift"
+    )
