@@ -286,24 +286,33 @@ def merged_map_weave(lanes, meta, order, rank, row: int):
     return weave
 
 
-def map_row_digest(lanes, rank, visible):
-    """Per-row uint32 digests over the forest lanes (same mix as
-    parallel.mesh.replica_digest, computed host-side on the raw lanes
-    — rank coordinates must match ``rank``'s)."""
-    hi = lanes["hi"].astype(np.uint32)
-    lo = lanes["lo"].astype(np.uint32)
+def map_row_digest(lanes, order, rank, visible):
+    """Per-row uint32 digests over the forest lanes — bit-identical to
+    the sharded path's device digest (parallel.mesh._fleet_stats):
+    the v4 kernel reports rank/visible per SORTED lane, so the id
+    lanes are re-sorted by ``order`` before the avalanche mix (pinned
+    by tests/test_mapw.py against the sharded output)."""
+    order = np.asarray(order).astype(np.int64)
+    hi = np.take_along_axis(lanes["hi"], order, axis=1).astype(np.uint32)
+    lo = np.take_along_axis(lanes["lo"], order, axis=1).astype(np.uint32)
     rank = np.asarray(rank).astype(np.int64)
     m = rank.shape[1]
     keptm = rank < m
     pos = np.where(keptm, rank, 0).astype(np.uint32)
     vis = np.asarray(visible).astype(np.uint32)
-    mix = (
+    x = (
         hi * np.uint32(0x9E3779B1)
-        ^ lo * np.uint32(0x85EBCA77)
-        ^ (pos * np.uint32(2654435761) + vis * np.uint32(40503)
-           + np.uint32(1))
+        + lo * np.uint32(0x85EBCA77)
+        + pos * np.uint32(0xC2B2AE35)
+        + vis * np.uint32(40503)
+        + np.uint32(1)
     )
-    return np.where(keptm, mix, np.uint32(0)).sum(axis=1, dtype=np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> np.uint32(13))
+    x = x * np.uint32(0xC2B2AE35)
+    x = x ^ (x >> np.uint32(16))
+    return np.where(keptm, x, np.uint32(0)).sum(axis=1, dtype=np.uint32)
 
 
 class MapWaveResult:
@@ -436,7 +445,7 @@ def merge_map_wave(pairs) -> MapWaveResult:
     order = np.asarray(order)
     rank = np.asarray(rank)
     visible = np.asarray(visible)
-    live_digest = map_row_digest(lanes, rank, visible)
+    live_digest = map_row_digest(lanes, order, rank, visible)
 
     # expand live rows back to the full index space
     full_order = np.zeros((B, N), np.int32)

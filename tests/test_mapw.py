@@ -70,13 +70,13 @@ def test_map_digests_detect_convergence():
     lanes, meta = mapw.pair_rows([(a.ct.nodes, b.ct.nodes)
                                   for a, b in pairs])
     order, rank, visible, _c_, _ov = mapw.batched_merge_map_weave(lanes)
-    d = mapw.map_row_digest(lanes, rank, visible)
+    d = mapw.map_row_digest(lanes, order, rank, visible)
     assert len(set(d.tolist())) == len(pairs)  # distinct pairs diverge
     # identical pair twice -> identical digests
     two = [pairs[0], pairs[0]]
     l2, m2 = mapw.pair_rows([(a.ct.nodes, b.ct.nodes) for a, b in two])
     _o2, r2, v2, _c2, _ov2 = mapw.batched_merge_map_weave(l2)
-    d2 = mapw.map_row_digest(l2, r2, v2)
+    d2 = mapw.map_row_digest(l2, _o2, r2, v2)
     assert d2[0] == d2[1]
 
 
@@ -93,6 +93,12 @@ def test_sharded_map_merge_agrees_with_batched():
     )
     assert int(n_ov) == 0
     assert np.array_equal(np.asarray(sr), np.asarray(rank))
+    # the host digest twin must stay bit-identical to the device mix
+    assert np.array_equal(
+        np.asarray(sdig),
+        mapw.map_row_digest(lanes, np.asarray(so), np.asarray(sr),
+                            np.asarray(sv)),
+    )
     for i in range(len(pairs)):
         assert_row_matches_pure(pairs, lanes, meta, np.asarray(so),
                                 np.asarray(sr), i)
