@@ -64,6 +64,14 @@ __all__ = [
 ]
 
 
+def _stage_ck(*xs):
+    """Scalar checksum keeping every given array live (profiling)."""
+    total = jnp.float32(0)
+    for x in xs:
+        total = total + jnp.sum(x.astype(jnp.float32))
+    return total
+
+
 def _lt(a1, a2, b1, b2):
     return (a1 < b1) | ((a1 == b1) & (a2 < b2))
 
@@ -113,7 +121,8 @@ def _pair_search_le(kh, kl, qh, ql, size):
 def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
                           sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
                           sg_len, sg_lane0, sg_dense, sg_tail_special,
-                          sg_valid, sg_vsum, u_max: int, k_max: int):
+                          sg_valid, sg_vsum, u_max: int, k_max: int,
+                          stage: str | None = None):
     """Union + reweave at segment granularity for one replica set.
 
     Node lanes as in v4 (``hi/lo/cci/vclass/valid`` — trees
@@ -122,6 +131,14 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     order. Returns ``(rank, visible, conflict, overflow)`` with rank
     and visibility indexed by CONCAT lane (not by sorted position —
     there is no full-width sorted order here).
+
+    ``stage`` (static; profiling only) returns early with one scalar
+    checksum of that phase's live outputs, so a prefix of the pipeline
+    can be timed on hardware without dead-code elimination hiding it:
+    ``"A"`` segment ordering + explode/dedupe, ``"B"`` token
+    construction, ``"C"`` token sort + dedupe, ``"D"`` cause
+    resolution, ``"E"`` token-width ranking + kills. ``None`` (the
+    default, the only non-test caller mode) runs the full kernel.
     """
     N = hi.shape[0]
     S = sg_len.shape[0]
@@ -218,6 +235,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
 
     twin_drop = same_prev & ~explode
     survive = s_va & ~explode & ~twin_drop
+    if stage == "A":
+        return _stage_ck(explode, survive, grp)
 
     # ================= B. token construction ========================
     tok_cnt = jnp.where(survive, 1, jnp.where(s_va & explode, s_len, 0))
@@ -244,6 +263,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     t_tsp = jnp.where(
         o_expl, t_vc > 0, s_tsp[oc]
     ) & u_ok
+    if stage == "B":
+        return _stage_ck(t_hi, t_lo, t_len, t_tsp)
 
     # token_of_lane machinery (PRESORT token ids). A cause lane inside
     # a twin-DROPPED segment copy (tree B's own copy of the shared
@@ -276,6 +297,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
         & (uidx > 0) & tva
     )
     keep_t = tva & ~sdup
+    if stage == "C":
+        return _stage_ck(st_hi, keep_t, sv_lane, inv_t)
 
     # ================= D. token cause resolution ====================
     cl = jnp.where(tva, cci[jnp.clip(sv_lane, 0, N - 1)], -1)
@@ -322,6 +345,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
             | (sv_len != _shift1(sv_len, 0))
         )
     )
+    if stage == "D":
+        return _stage_ck(parent_su, cause_su, conflict)
 
     # ================= E. v4 pipeline at token width ================
     wcum = jnp.cumsum(jnp.where(keep_t, sv_len, 0))
@@ -448,6 +473,11 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # from another replica names its own dropped copy of the tail)
     kill_tail = r_valid & s_is_hide & (cause_su[s_c] == tail_tok)
     vict_tail = jnp.where(kill_tail, sv_tail_lane[t_cc], N)
+    if stage == "E":
+        # conflict included so prefix increments stay strictly
+        # cumulative over stage D's reduction
+        return _stage_ck(rank_tok, vict_inrun, vict_tail, kill_tail,
+                         conflict)
 
     # ================= F. expansion to concat lanes =================
     # token base + token lane, in LANE order (sort tokens by lane) so
@@ -526,7 +556,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
 
 
 merge_weave_kernel_v5_jit = jax.jit(
-    merge_weave_kernel_v5, static_argnames=("u_max", "k_max")
+    merge_weave_kernel_v5, static_argnames=("u_max", "k_max", "stage")
 )
 
 

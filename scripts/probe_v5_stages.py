@@ -1,0 +1,101 @@
+"""Cumulative-prefix phase timing of the v5 kernel on real hardware.
+
+Runs the kernel truncated at each stage checkpoint (jaxw5
+``stage=`` early returns, each checksumming its live outputs so XLA
+cannot DCE the prefix) at the north-star bench shape, and prints the
+per-stage increments. This is the measurement probe probe_v5.py's
+isolated re-implementations can't give: the *actual* compiled prefix
+cost, gathers, vmap batching and all.
+
+Stages: A segment ordering + explode/dedupe; B token construction;
+C token sort + dedupe; D cause resolution (binary search + host walk);
+E token-width ranking + kills; FULL adds lane expansion + visibility.
+
+Usage: python -u scripts/probe_v5_stages.py [--smoke] [--reps N]
+"""
+
+from __future__ import annotations
+
+import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
+
+import argparse
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS5
+from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5
+
+
+def main():
+    from cause_tpu.benchgen import enable_compile_cache
+
+    enable_compile_cache()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--reps", type=int, default=3)
+    a = ap.parse_args()
+    if a.smoke:
+        B, NB, ND, CAP = 8, 800, 100, 1024
+    else:
+        B, NB, ND, CAP = 1024, 9_000, 1_000, 10_240
+
+    print(f"platform={jax.devices()[0].platform} B={B} cap={CAP}",
+          flush=True)
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=NB, n_div=ND, capacity=CAP, hide_every=8
+    )
+    v5 = benchgen.batched_v5_inputs(batch, CAP)
+    u = benchgen.v5_token_budget(v5)
+    print(f"u_budget={u} S={v5['sg_len'].shape[1]} "
+          f"N={v5['hi'].shape[1]}", flush=True)
+    dev = {k: jax.device_put(v5[k]) for k in LANE_KEYS5}
+    args = [dev[k] for k in LANE_KEYS5]
+
+    progs = {}
+
+    def prog_for(stage):
+        if stage not in progs:
+            def row(*xs):
+                out = merge_weave_kernel_v5(*xs, u_max=u, k_max=u,
+                                            stage=stage)
+                if stage is None:
+                    rank, visible, conflict, overflow = out
+                    return (jnp.sum(rank.astype(jnp.float32))
+                            + jnp.sum(visible.astype(jnp.float32))
+                            + conflict.astype(jnp.float32)
+                            + overflow.astype(jnp.float32))
+                return out
+
+            progs[stage] = jax.jit(
+                lambda *xs: jnp.sum(jax.vmap(row)(*xs))
+            )
+        return progs[stage]
+
+    prev = 0.0
+    for stage in ("A", "B", "C", "D", "E", None):
+        p = prog_for(stage)
+        try:
+            np.asarray(p(*args))  # compile + warm
+            ts = []
+            for _ in range(a.reps):
+                t0 = time.perf_counter()
+                np.asarray(p(*args))
+                ts.append((time.perf_counter() - t0) * 1000.0)
+            med = float(np.median(ts))
+            name = stage or "FULL"
+            print(f"prefix->{name:4s} {med:9.1f} ms   "
+                  f"(+{med - prev:8.1f} ms)", flush=True)
+            prev = med
+        except Exception as e:  # noqa: BLE001 - keep probing
+            print(f"prefix->{stage or 'FULL'} FAILED "
+                  f"{type(e).__name__}: {str(e).splitlines()[0][:120]}",
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+#!/bin/bash
+# TPU measurement recovery queue (round 3). Serialized: exactly one
+# axon claimant at a time (every python process with
+# PALLAS_AXON_POOL_IPS set claims a tunnel session at interpreter
+# start — see tests/conftest.py note; concurrent claimants queue on
+# the relay and starve each other).
+#
+# Usage: nohup bash scripts/run_queue.sh [pid-to-wait-for] &
+# Logs into measurements/. Never kills a client (round-2 lesson:
+# a killed axon client mid-compile can wedge the tunnel server).
+set -u
+cd "$(dirname "$0")/.."
+mkdir -p measurements
+
+WAIT_PID="${1:-}"
+if [ -n "$WAIT_PID" ]; then
+  echo "queue: waiting for pid $WAIT_PID to finish" >&2
+  while kill -0 "$WAIT_PID" 2>/dev/null; do sleep 20; done
+fi
+
+run() {
+  name="$1"; shift
+  echo "queue: [$(date -u +%H:%M:%S)] start $name" >&2
+  timeout --signal=CONT 3600 "$@" > "measurements/${name}.log" 2>&1
+  # SIGCONT timeout = no-op kill: we only bound the queue's own wait.
+  # If the child is still alive after, we wait for it (never kill).
+  echo "queue: [$(date -u +%H:%M:%S)] done $name rc=$?" >&2
+}
+
+run probe_v5_stages_tpu_r3 python -u scripts/probe_v5_stages.py
+run probe_v4_tpu_r3 python -u scripts/probe_v4.py
+run pallas_probe_tpu_r3 python -u scripts/pallas_probe.py
+run fleet_bench_tpu_r3 python -u scripts/fleet_bench.py
+run microbench_tpu_r3 python -u scripts/tpu_microbench.py
+echo "queue: all done" >&2
