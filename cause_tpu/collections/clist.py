@@ -39,9 +39,10 @@ __all__ = [
 ]
 
 
-def new_causal_tree(weaver: str = "pure") -> CausalTree:
+def new_causal_tree(weaver: str = "pure", lazy: bool = False) -> CausalTree:
     """A fresh list tree seeded with the root sentinel in all three
-    stores (list.cljc:11-18)."""
+    stores (list.cljc:11-18). ``lazy`` defers the weave cache to first
+    read (shared.ensure_weave) — the fleet-editing mode."""
     return CausalTree(
         type=s.LIST_TYPE,
         lamport_ts=0,
@@ -51,6 +52,7 @@ def new_causal_tree(weaver: str = "pure") -> CausalTree:
         yarns={"0": [ROOT_NODE]},
         weave=[ROOT_NODE],
         weaver=weaver,
+        lazy_weave=lazy,
     )
 
 
@@ -83,11 +85,19 @@ def weave(ct: CausalTree, node=None, more_consecutive_nodes_in_same_tx=None) -> 
     )
 
 
+def _tail_id(ct: CausalTree):
+    """Id of the last weave node — from the lazy tail hint when it is
+    alive (no weave needed), else from the (materialized) weave."""
+    if ct.weave is None and ct.weave_tail is not None:
+        return ct.weave_tail
+    return s.ensure_weave(weave, ct).weave[-1][0]
+
+
 def conj_(ct: CausalTree, *values) -> CausalTree:
     """Append value(s) after the last node of the current weave
     (list.cljc:36-40)."""
     for v in values:
-        ct = s.append(weave, ct, ct.weave[-1][0], v)
+        ct = s.append(weave, ct, _tail_id(ct), v)
     return ct
 
 
@@ -109,8 +119,8 @@ def extend_(ct: CausalTree, values) -> CausalTree:
     values = list(values)
     while values:
         chunk, values = values[:MAX_TX_RUN], values[MAX_TX_RUN:]
+        cause = _tail_id(ct)
         ct = ct.evolve(lamport_ts=ct.lamport_ts + 1)
-        cause = ct.weave[-1][0]
         nodes = []
         for i, v in enumerate(chunk):
             nid = (ct.lamport_ts, ct.site_id, i)
@@ -121,9 +131,10 @@ def extend_(ct: CausalTree, values) -> CausalTree:
 
 
 def empty_(ct: CausalTree) -> CausalTree:
-    """A fresh tree preserving identity (site-id, uuid, weaver)
-    (list.cljc:45-46)."""
-    return new_causal_tree(ct.weaver).evolve(site_id=ct.site_id, uuid=ct.uuid)
+    """A fresh tree preserving identity (site-id, uuid, weaver, lazy
+    mode) (list.cljc:45-46)."""
+    return new_causal_tree(ct.weaver, lazy=ct.lazy_weave).evolve(
+        site_id=ct.site_id, uuid=ct.uuid)
 
 
 def hide_q(node, next_node_in_weave) -> bool:
@@ -141,7 +152,7 @@ def hide_q(node, next_node_in_weave) -> bool:
 def causal_list_to_edn(ct: CausalTree, opts: Optional[dict] = None) -> list:
     """Materialize the current state as a plain list (list.cljc:57-66):
     pairwise scan over the weave keeping visible values."""
-    w = ct.weave
+    w = s.ensure_weave(weave, ct).weave
     out = []
     for i, n in enumerate(w):
         nr = w[i + 1] if i + 1 < len(w) else None
@@ -152,7 +163,7 @@ def causal_list_to_edn(ct: CausalTree, opts: Optional[dict] = None) -> list:
 
 def causal_list_to_list(ct: CausalTree) -> list:
     """The visible *nodes* in weave order (list.cljc:68-72)."""
-    w = ct.weave
+    w = s.ensure_weave(weave, ct).weave
     out = []
     for i, n in enumerate(w):
         nr = w[i + 1] if i + 1 < len(w) else None
@@ -177,6 +188,11 @@ class CausalList(ListTreeHandle):
     # -- CausalTo (protocols.cljc:33-35) --
     def causal_to_edn(self, opts: Optional[dict] = None) -> list:
         return causal_list_to_edn(self.ct, opts)
+
+    def tail_id(self):
+        """Id of the last weave node — what ``conj`` will cause. On a
+        lazy tree with a live tail hint this is O(1), no weave needed."""
+        return _tail_id(self.ct)
 
     # -- Python container interop (mirrors list.cljc:74-135) --
     def conj(self, *values) -> "CausalList":
@@ -236,9 +252,12 @@ class CausalList(ListTreeHandle):
         return str(causal_list_to_list(self.ct))
 
 
-def new_causal_list(*items, weaver: str = "pure") -> CausalList:
-    """Create a new causal list containing the items (list.cljc:175-178)."""
-    cl = CausalList(new_causal_tree(weaver))
+def new_causal_list(*items, weaver: str = "pure",
+                    lazy: bool = False) -> CausalList:
+    """Create a new causal list containing the items (list.cljc:175-178).
+    ``lazy=True`` defers weave maintenance to first read — the editing
+    mode for device-backed fleet replicas (shared.CausalTree.lazy_weave)."""
+    cl = CausalList(new_causal_tree(weaver, lazy=lazy))
     if items:
         cl = cl.conj(*items)
     return cl

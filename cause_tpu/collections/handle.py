@@ -59,7 +59,7 @@ class ListTreeHandle:
 
     # -- CausalTree protocol (protocols.cljc:12-31) --
     def get_weave(self):
-        return self.ct.weave
+        return _s.ensure_weave(self._weave_fn(), self.ct).weave
 
     def get_nodes(self):
         return self.ct.nodes
@@ -117,7 +117,23 @@ class ListTreeHandle:
         return self.ct.meta
 
     def __eq__(self, other) -> bool:
-        return isinstance(other, type(self)) and self.ct == other.ct
+        if not isinstance(other, type(self)):
+            return False
+        a, b = self.ct, other.ct
+        # cheap fields first, so a trivially-unequal compare (membership
+        # tests, different uuids) never pays a stale-weave
+        # materialization
+        if (a.type, a.lamport_ts, a.uuid, a.site_id, a.weaver,
+                a.nodes, a.yarns) != (
+                b.type, b.lamport_ts, b.uuid, b.site_id, b.weaver,
+                b.nodes, b.yarns):
+            return False
+        # everything canonical matches; a lazy handle equals its eager
+        # twin, so materialize any stale weave before the final compare
+        for ct_ in (a, b):
+            if ct_.weave is None:
+                _s.ensure_weave(self._weave_fn(), ct_)
+        return a.weave == b.weave
 
     def __hash__(self) -> int:
         return hash((self.ct.uuid, self.ct.lamport_ts, self.ct.site_id,

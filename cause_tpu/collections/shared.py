@@ -44,6 +44,7 @@ __all__ = [
     "assoc_nodes",
     "spin",
     "insert",
+    "ensure_weave",
     "append",
     "refresh_ts",
     "yarns_to_nodes",
@@ -88,6 +89,18 @@ class CausalTree:
     # arbitrary attachment that never affects equality and is not
     # serialized — Clojure metadata semantics.
     meta: Any = field(default=None, compare=False)
+    # Lazy weave mode (list trees, opt-in): inserts skip the O(n) host
+    # weave splice entirely; ``weave=None`` marks the cache stale and
+    # any reader materializes it once via ``ensure_weave`` (a full
+    # rebuild — device-routed under weaver="jax"). ``weave_tail`` is
+    # the one incremental fact kept alive while stale: the id of the
+    # current last weave node, valid only for the append-at-tail chain
+    # (``conj``'s cause), invalidated by any other insert. No
+    # reference analogue — the reference always weaves eagerly
+    # (shared.cljc:12); this is the TPU-fleet editing mode where the
+    # device wave, not the host, owns linearization.
+    lazy_weave: bool = field(default=False, compare=False)
+    weave_tail: Any = field(default=None, compare=False, repr=False)
     # CACHE: marshalled device lanes (weaver.lanecache.LaneView), the
     # fourth disposable cache next to yarns/weave — maintained on the
     # append fast path, attached by the device weaver after rebuilds,
@@ -197,6 +210,19 @@ def insert(weave_fn: WeaveFn, ct: CausalTree, node, more_nodes_in_tx=None) -> Ca
                 {"causes": {"cause-must-exist"}},
             )
         seen.add(nd[0])
+    # a non-chaining same-tx run is the one input whose INCREMENTAL
+    # weave (contiguous splice at the run head's cause — the
+    # runs-stick-together rule) differs from a from-scratch rebuild
+    # (each node at its own cause). Lazy deferral implies rebuild
+    # semantics, so such a run must weave eagerly: materialize first,
+    # then take the normal splice path below.
+    lazy = ct.lazy_weave and ct.type == LIST_TYPE
+    chained = all(
+        nodes[i + 1][1] == nodes[i][0] for i in range(len(nodes) - 1)
+    )
+    if lazy and not chained:
+        ensure_weave(weave_fn, ct)
+        lazy = False
     lanes0 = ct.lanes
     if node[0][0] > ct.lamport_ts:
         ct = ct.evolve(lamport_ts=node[0][0])
@@ -206,7 +232,46 @@ def insert(weave_fn: WeaveFn, ct: CausalTree, node, more_nodes_in_tx=None) -> Ca
         from ..weaver import lanecache
 
         ct = ct.evolve(lanes=lanecache.extend_view(lanes0, nodes))
+    if lazy:
+        return _lazy_after_insert(ct, nodes)
     return weave_fn(ct, node, more_nodes_in_tx)
+
+
+def _lazy_after_insert(ct: CausalTree, nodes) -> CausalTree:
+    """Skip the weave splice; keep only the tail hint alive.
+
+    Callers guarantee the run chains (each next node causes the
+    previous — non-chaining runs weave eagerly, see ``insert``). The
+    hint survives exactly the append-at-tail case: the run's first
+    cause is the current last weave node. The tail has no woven
+    children by definition, so such a run lands immediately after it
+    and its last node becomes the new tail — for local conj, pastes,
+    AND foreign appends alike. Anything else (mid-weave insert, cons,
+    a stale foreign branch) may displace the last element in ways only
+    a weave scan can see, so the hint dies and the next tail read pays
+    one materialization."""
+    prev_tail = ct.weave[-1][0] if ct.weave is not None else ct.weave_tail
+    new_tail = None
+    if prev_tail is not None and nodes[0][1] == prev_tail:
+        new_tail = nodes[-1][0]
+    return ct.evolve(weave=None, weave_tail=new_tail)
+
+
+def ensure_weave(weave_fn: WeaveFn, ct: CausalTree) -> CausalTree:
+    """Materialize a lazy tree's weave in place (no-op when fresh).
+
+    The weave is a pure function of ``nodes``, so back-filling the
+    frozen dataclass's cache field is referentially transparent — the
+    same discipline as the lanes cache. Returns ``ct`` itself, now
+    woven."""
+    if ct.weave is not None:
+        return ct
+    fresh = weave_fn(ct)  # full rebuild; device-routed under "jax"
+    object.__setattr__(ct, "weave", fresh.weave)
+    object.__setattr__(ct, "weave_tail", None)
+    if fresh.lanes is not None:
+        object.__setattr__(ct, "lanes", fresh.lanes)
+    return ct
 
 
 def append(weave_fn: WeaveFn, ct: CausalTree, cause, value) -> CausalTree:
@@ -269,6 +334,7 @@ def weft(weave_fn: WeaveFn, new_causal_tree_fn: Callable[[], CausalTree],
         site_id=ct.site_id,
         lamport_ts=max((i[0] for i in filtered), default=0),
         weaver=ct.weaver,
+        lazy_weave=ct.lazy_weave,
     )
     new_ct = yarns_to_nodes(new_ct)
     return weave_fn(new_ct)

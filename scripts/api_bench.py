@@ -72,7 +72,7 @@ def wave_bench(args):
 
     t0 = time.perf_counter()
     base = CausalList(c_list.weave(
-        c.clist(weaver="jax").extend(["x"] * n_base).ct
+        c.clist(weaver="jax", lazy=args.lazy).extend(["x"] * n_base).ct
     ))
     pairs = []
     for p in range(B):
@@ -84,7 +84,7 @@ def wave_bench(args):
             vals = [f"{tag}{p}.{i}" for i in range(n_div)]
             for start in range(0, n_div, 8):
                 r = r.extend(vals[start:start + 8])
-                r = r.append(list(r.ct.weave[-1:])[0][0], c.hide)
+                r = r.append(r.tail_id(), c.hide)
             return r
 
         pairs.append((replica("a"), replica("b")))
@@ -261,6 +261,9 @@ def main():
     ap.add_argument("--burst", type=int, default=8,
                     help="pipelined waves per amortized measurement")
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--lazy", action="store_true",
+                    help="lazy-weave replicas: skip the per-op host "
+                         "weave splice in the edit loop")
     ap.add_argument("--cpu", action="store_true")
     args = ap.parse_args()
     if args.cpu:

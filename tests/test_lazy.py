@@ -1,0 +1,150 @@
+"""Lazy weave mode: inserts skip the host weave splice; readers
+materialize once (shared.ensure_weave). Differential contract: a lazy
+tree is observationally identical to its eager twin under every op
+sequence. No reference analogue (the reference weaves eagerly,
+shared.cljc:12) — this is the TPU-fleet editing mode."""
+
+import random
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu.collections import shared as s
+from cause_tpu.collections import clist as clist_mod
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import HIDE, new_site_id
+
+
+def lazy_twin(cl: CausalList) -> CausalList:
+    return CausalList(cl.ct.evolve(lazy_weave=True))
+
+
+def test_conj_stays_stale_via_tail_hint():
+    cl = c.clist("a", "b", lazy=True)
+    cl = cl.conj("c", "d")
+    # no reader ran: the weave was never materialized, the hint carried
+    assert cl.ct.weave is None
+    assert cl.ct.weave_tail is not None
+    assert cl.causal_to_edn() == ["a", "b", "c", "d"]
+    # reading cached the weave back in place
+    assert cl.ct.weave is not None
+
+
+def test_extend_carries_hint_and_matches_eager():
+    lz = c.clist(lazy=True).extend(["x", "y", "z"])
+    assert lz.ct.weave is None and lz.ct.weave_tail is not None
+    eg = c.clist().extend(["x", "y", "z"])
+    assert lz.causal_to_edn() == eg.causal_to_edn() == ["x", "y", "z"]
+
+
+def test_hide_at_tail_keeps_hint_and_chains_like_eager():
+    # eager conj causes weave[-1] even when it is a special; the lazy
+    # hint must reproduce exactly that chaining
+    eg = c.clist("a")
+    lz = lazy_twin(eg)
+    tail = [n[0] for n in list(eg)][-1]
+    lz = CausalList(s.append(clist_mod.weave, lz.ct, tail, HIDE))
+    eg = CausalList(s.append(clist_mod.weave, eg.ct, tail, HIDE))
+    assert lz.ct.weave is None and lz.ct.weave_tail is not None
+    lz, eg = lz.conj("b"), eg.conj("b")
+    assert lz.causal_to_edn() == eg.causal_to_edn() == ["b"]
+
+
+def test_cons_kills_hint_then_one_materialization():
+    lz = c.clist("a", lazy=True).cons(">")
+    assert lz.ct.weave is None and lz.ct.weave_tail is None
+    lz2 = lz.conj("b")  # forced one materialization for the tail read
+    assert lz2.causal_to_edn() == c.clist("a").cons(">").conj(
+        "b").causal_to_edn()
+
+
+def test_lazy_equals_eager_handle():
+    lz = c.clist("a", "b", lazy=True).conj("c")
+    eg = CausalList(lz.ct.evolve(lazy_weave=False))
+    eg = s.ensure_weave(clist_mod.weave, eg.ct)
+    assert c.clist("x") != c.clist("x", lazy=True)  # different uuids
+    assert CausalList(lz.ct) == CausalList(eg)
+
+
+def test_serde_round_trips_stale_tree():
+    from cause_tpu import serde
+
+    lz = c.clist("a", lazy=True).conj("b", "c")
+    assert lz.ct.weave is None
+    back = serde.loads(serde.dumps(lz))
+    assert back.causal_to_edn() == ["a", "b", "c"]
+
+
+def test_non_chaining_run_weaves_eagerly():
+    """A same-tx run whose nodes do NOT chain is the one input where
+    incremental splice semantics (runs stick together) differ from a
+    from-scratch rebuild (each node at its own cause) — a lazy tree
+    must weave it eagerly to stay equal to its eager twin."""
+    eg = c.clist("a", "b", "c")
+    lz = lazy_twin(eg)
+    ids = [n[0] for n in list(eg)]
+    ts = eg.ct.lamport_ts + 1
+    n1 = ((ts, eg.ct.site_id, 0), ids[-1], "R1")
+    n2 = ((ts, eg.ct.site_id, 1), ids[0], "R2")  # causes a, not n1
+    eg2 = CausalList(s.insert(clist_mod.weave, eg.ct, n1, [n2]))
+    lz2 = CausalList(s.insert(clist_mod.weave, lz.ct, n1, [n2]))
+    assert lz2.causal_to_edn() == eg2.causal_to_edn()
+    assert lz2 == eg2
+
+
+def test_empty_and_weft_preserve_lazy_flag():
+    lz = c.clist("a", "b", lazy=True)
+    assert lz.empty().ct.lazy_weave
+    ids = [n[0] for n in list(lz)]
+    assert lz.weft([ids[0]]).ct.lazy_weave
+
+
+@pytest.mark.parametrize("weaver", ["pure", "jax"])
+def test_differential_fuzz_lazy_vs_eager(weaver):
+    """Random op soup (conj/cons/extend/hide/foreign insert/merge):
+    the lazy twin tracks the eager tree exactly at every checkpoint."""
+    list_weave = clist_mod.weave
+    rng = random.Random(13)
+    eg = c.clist("s", weaver=weaver)
+    lz = lazy_twin(eg)
+    foreign = new_site_id()
+    for step in range(40):
+        op = rng.randrange(6)
+        if op == 0:
+            v = f"v{step}"
+            eg, lz = eg.conj(v), lz.conj(v)
+        elif op == 1:
+            v = f"c{step}"
+            eg, lz = eg.cons(v), lz.cons(v)
+        elif op == 2:
+            vs = [f"e{step}_{i}" for i in range(rng.randrange(1, 4))]
+            eg, lz = eg.extend(vs), lz.extend(vs)
+        elif op == 3:
+            # hide a random existing node (same target both sides)
+            nodes = sorted(eg.ct.nodes)
+            nid = nodes[rng.randrange(len(nodes))]
+            if nid != (0, "0", 0):
+                n = ((eg.ct.lamport_ts + 1, eg.ct.site_id, 0), nid, HIDE)
+                eg = CausalList(s.insert(list_weave,
+                                         eg.ct.evolve(
+                                             lamport_ts=n[0][0]), n))
+                lz = CausalList(s.insert(list_weave,
+                                         lz.ct.evolve(
+                                             lamport_ts=n[0][0]), n))
+        elif op == 4:
+            # foreign-site node caused by a random existing node
+            nodes = sorted(eg.ct.nodes)
+            cause = nodes[rng.randrange(len(nodes))]
+            n = ((eg.ct.lamport_ts + 1, foreign, 0), cause, f"f{step}")
+            eg = CausalList(s.insert(list_weave, eg.ct, n))
+            lz = CausalList(s.insert(list_weave, lz.ct, n))
+        else:
+            # divergent foreign replica merged back in
+            rep = CausalList(eg.ct.evolve(site_id=foreign))
+            rep = rep.conj(f"m{step}")
+            eg, lz = eg.merge(rep), lz.merge(rep)
+        if step % 7 == 0:
+            assert lz.causal_to_edn() == eg.causal_to_edn(), step
+    assert lz.causal_to_edn() == eg.causal_to_edn()
+    assert lz.get_weave() == eg.get_weave()
+    assert lz.ct.nodes == eg.ct.nodes
