@@ -163,12 +163,13 @@ def measure(platform: str) -> dict:
     u_budget = benchgen.v5_token_budget(v5batch)
 
     def dispatch(k: int, kernel: str):
-        lanes = (LANE_KEYS5 if kernel == "v5"
-                 else LANE_KEYS4 if kernel == "v4" else LANE_KEYS)
+        lanes = (LANE_KEYS5 if kernel in ("v5", "v5w")
+                 else LANE_KEYS4 if kernel in ("v4", "v4w")
+                 else LANE_KEYS)
         args = [dev[name] for name in lanes]
         return merge_wave_scalar(
             *args, k_max=k, kernel=kernel,
-            u_max=k if kernel == "v5" else 0,
+            u_max=k if kernel in ("v5", "v5w") else 0,
         )
 
     def step(k: int, kernel: str) -> None:
@@ -195,9 +196,24 @@ def measure(platform: str) -> dict:
     # (merge cost ~ divergence), then v4 (marshal-resolved causes at
     # full width), then the chain-compressed v2 with a doubled budget,
     # then the uncompressed v1 (k_max=0, cannot overflow).
-    for k_max, kernel in ((u_budget, "v5"), (2 * u_budget, "v5"),
-                          (budget, "v4"), (2 * budget, "v4"),
-                          (2 * budget, "v2"), (0, "v1")):
+    # BENCH_KERNEL prepends an explicit first choice (e.g. "v5w", the
+    # Pallas-euler-walk variant the measurement queue probes on TPU).
+    ladder = [(u_budget, "v5"), (2 * u_budget, "v5"),
+              (budget, "v4"), (2 * budget, "v4"),
+              (2 * budget, "v2"), (0, "v1")]
+    forced = os.environ.get("BENCH_KERNEL", "").strip()
+    if forced:
+        # budget units differ per family: tokens for v5*, runs for the
+        # contracted kernels; an unknown name must fail loudly, not
+        # silently time v2 under the forced label
+        family = {"v5": u_budget, "v5w": u_budget, "v4": budget,
+                  "v4w": budget, "v3": 2 * budget, "v2": 2 * budget}
+        if forced not in family:
+            raise SystemExit(f"bench: unknown BENCH_KERNEL {forced!r}; "
+                             f"one of {sorted(family)}")
+        fb = family[forced]
+        ladder = [(fb, forced), (2 * fb, forced)] + ladder
+    for k_max, kernel in ladder:
         try:
             step(k_max, kernel)
             break
@@ -265,6 +281,11 @@ def main() -> None:
     errors = []
     for platform, timeout, tag, extra in attempts:
         env = dict(os.environ, BENCH_EXEC=platform, BENCH_TAG=tag, **extra)
+        if platform == "cpu":
+            # a forced Pallas-walk kernel runs in interpret mode off-TPU
+            # — sequential per row at full size, it would burn the whole
+            # fallback timeout; the CPU evidence uses the default ladder
+            env.pop("BENCH_KERNEL", None)
         got = _run_abandonable([sys.executable, __file__], env, timeout)
         if got is None:
             errors.append(f"{platform}: abandoned after {timeout:.0f}s")

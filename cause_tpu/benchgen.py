@@ -180,7 +180,8 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
     ``k_max`` > 0 selects a compressed kernel — ``kernel`` picks which
     ("v2" chain-compressed, "v3" sparse-irregular, "v4"
     marshal-resolved causes, "v4w" = v4 with the sequential Pallas
-    euler walk, "v5" segment-union with token budget ``u_max``) — with
+    euler walk, "v5" segment-union with token budget ``u_max``,
+    "v5w" = v5 with the Pallas euler walk) — with
     that run budget, returning a length-2 device array ``[checksum,
     n_overflowed_rows]`` (one transfer fetches both); ``k_max=0`` runs
     the uncompressed v1 kernel and returns just the checksum. v1-v3
@@ -205,14 +206,16 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
                 + jnp.sum(conflict.astype(jnp.float32))
             )
 
-        if k_max > 0 and kernel == "v5":
+        if k_max > 0 and kernel in ("v5", "v5w"):
             from .weaver.jaxw5 import batched_merge_weave_v5
+
+            _euler = "walk" if kernel == "v5w" else "doubling"
 
             @jax.jit
             def program(*a):
                 rank, visible, conflict, overflow = (
                     batched_merge_weave_v5(
-                        *a, u_max=u_max, k_max=k_max
+                        *a, u_max=u_max, k_max=k_max, euler=_euler
                     )
                 )
                 return jnp.stack([

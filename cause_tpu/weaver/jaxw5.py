@@ -122,7 +122,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
                           sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
                           sg_len, sg_lane0, sg_dense, sg_tail_special,
                           sg_valid, sg_vsum, u_max: int, k_max: int,
-                          stage: str | None = None):
+                          stage: str | None = None,
+                          euler: str = "doubling"):
     """Union + reweave at segment granularity for one replica set.
 
     Node lanes as in v4 (``hi/lo/cci/vclass/valid`` — trees
@@ -412,7 +413,12 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     sord = jnp.lexsort((-hc, packed))
     fc, ns = _link_children(sord, parent_sort)
     parent_up = jnp.where(r_valid & (parent_run >= 0), parent_run, -1)
-    base_run, _ = _euler_rank(fc, ns, parent_up, run_w)
+    if euler == "walk":
+        from .pallas_ops import euler_walk
+
+        base_run = euler_walk(fc, ns, parent_up, run_w, k_max)
+    else:
+        base_run, _ = _euler_rank(fc, ns, parent_up, run_w)
 
     # expand run bases to token bases (node units): delta-scatter at
     # run-head tokens + one cumsum over U, then add within-run offset
@@ -556,21 +562,26 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
 
 
 merge_weave_kernel_v5_jit = jax.jit(
-    merge_weave_kernel_v5, static_argnames=("u_max", "k_max", "stage")
+    merge_weave_kernel_v5,
+    static_argnames=("u_max", "k_max", "stage", "euler"),
 )
 
 
-@partial(jax.jit, static_argnames=("u_max", "k_max"))
+@partial(jax.jit, static_argnames=("u_max", "k_max", "euler"))
 def batched_merge_weave_v5(hi, lo, cci, vclass, valid, seg,
                            sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
                            sg_len, sg_lane0, sg_dense, sg_tail_special,
-                           sg_valid, sg_vsum, u_max: int, k_max: int):
+                           sg_valid, sg_vsum, u_max: int, k_max: int,
+                           euler: str = "doubling"):
     """Segment-union batch: [B, N] node lanes + [B, S] segment tables
     -> per-replica (rank, visible, conflict, overflow), rank/visible
-    indexed by concat lane."""
+    indexed by concat lane. ``euler="walk"`` ranks the contracted
+    forest with the sequential Pallas traversal (pallas_ops.euler_walk)
+    instead of log-depth pointer doubling."""
 
     def row(*a):
-        return merge_weave_kernel_v5(*a, u_max=u_max, k_max=k_max)
+        return merge_weave_kernel_v5(*a, u_max=u_max, k_max=k_max,
+                                     euler=euler)
 
     return jax.vmap(row)(hi, lo, cci, vclass, valid, seg,
                          sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,

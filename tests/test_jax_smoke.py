@@ -90,6 +90,24 @@ def test_v5_tiny_pair_parity():
     assert np.array_equal(np.asarray(v), vis1)
 
 
+def test_v5w_walk_parity_tiny():
+    """euler="walk" (sequential Pallas traversal, interpret mode on
+    CPU) must rank the v5 token forest identically to the
+    pointer-doubling default."""
+    from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5_jit
+
+    row = tiny_pair()
+    v5row = benchgen.v5_inputs(row, CAP)
+    u = benchgen.v5_token_budget(v5row)
+    args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+    got_d = merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+    got_w = merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u,
+                                      euler="walk")
+    for d, w, name in zip(got_d, got_w,
+                          ("rank", "visible", "conflict", "overflow")):
+        assert np.array_equal(np.asarray(d), np.asarray(w)), name
+
+
 def test_api_merge_parity_all_backends_extend_shape():
     """API-level pair merge on an extend-built (tx-run) tree: jax and
     native must match pure — tiny twin of the suites' big fuzz."""
