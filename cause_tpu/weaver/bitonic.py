@@ -1,0 +1,121 @@
+"""Static bitonic sorting network — a TPU-shaped ``lax.sort``.
+
+XLA's TPU sort lowers a variadic comparator loop whose constants the
+round-2 microbenches showed dominating kernel phases; a bitonic
+network is log^2(n) *elementwise* compare-exchange stages (reshape +
+min/max/select only), which the VPU streams at full width across any
+leading batch dimensions — no comparator calls, no data-dependent
+control flow, fully fusable. At the weave kernels' token widths
+(~2k-4k lanes) that is ~78 static stages.
+
+Semantics: ``bitonic_sort(operands, num_keys)`` sorts along the LAST
+axis, ascending and lexicographic over the first ``num_keys``
+operands; remaining operands ride as payloads (same contract as
+``lax.sort``). Unlike ``lax.sort`` the network is not stable, so the
+original position is appended as an implicit final key — the result
+is the unique fully-deterministic stable order, for every input
+(including duplicate keys).
+
+``sort_pairs`` is the drop-in the kernels use; it dispatches to
+``lax.sort`` unless ``CAUSE_TPU_SORT=bitonic`` (read at trace time),
+so hardware A/B needs no code change.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["bitonic_sort", "sort_pairs"]
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _lex_lt(lo_keys, hi_keys):
+    """Elementwise lexicographic lo < hi over aligned key lists."""
+    lt = None
+    eq = None
+    for a, b in zip(lo_keys, hi_keys):
+        this_lt = a < b
+        this_eq = a == b
+        if lt is None:
+            lt, eq = this_lt, this_eq
+        else:
+            lt = lt | (eq & this_lt)
+            eq = eq & this_eq
+    return lt
+
+
+def bitonic_sort(operands, num_keys: int = 1):
+    """Sort int32 arrays along the last axis (see module docstring).
+
+    Returns the operands tuple in the same order, sorted. Keys must be
+    int32-comparable; padding uses int32 max so every real key must be
+    strictly below it (true for all kernel lanes, which reserve
+    ``I32_MAX`` as the invalid sentinel — those sort last, exactly as
+    with ``lax.sort``)."""
+    operands = tuple(operands)
+    n = operands[0].shape[-1]
+    p = _next_pow2(n)
+    lead = operands[0].shape[:-1]
+    iota = jnp.broadcast_to(
+        jnp.arange(p, dtype=jnp.int32), lead + (p,)
+    )
+    arrs = []
+    for i, x in enumerate(operands):
+        if p != n:
+            fill = _I32_MAX if i < num_keys else 0
+            pad = jnp.full(lead + (p - n,), fill, x.dtype)
+            x = jnp.concatenate([x, pad], axis=-1)
+        arrs.append(x)
+    arrs.append(iota)  # implicit final key: deterministic stability
+    key_pos = list(range(num_keys)) + [len(arrs) - 1]
+
+    k = 2
+    while k <= p:
+        j = k // 2
+        while j >= 1:
+            nb = p // (2 * j)
+            asc = ((jnp.arange(nb, dtype=jnp.int32) * 2 * j) & k) == 0
+            asc = asc[:, None]  # [nb, 1] broadcasts over the j axis
+            rs = [x.reshape(lead + (nb, 2, j)) for x in arrs]
+            lo = [x[..., 0, :] for x in rs]
+            hi = [x[..., 1, :] for x in rs]
+            lt = _lex_lt([lo[i] for i in key_pos],
+                         [hi[i] for i in key_pos])
+            keep = jnp.where(asc, lt, ~lt)
+            out = []
+            for a, b in zip(lo, hi):
+                first = jnp.where(keep, a, b)
+                second = jnp.where(keep, b, a)
+                out.append(
+                    jnp.stack([first, second], axis=-2).reshape(
+                        lead + (p,)
+                    )
+                )
+            arrs = out
+            j //= 2
+        k *= 2
+
+    arrs = arrs[:-1]  # drop the iota key
+    if p != n:
+        arrs = [x[..., :n] for x in arrs]
+    return tuple(arrs)
+
+
+def sort_pairs(operands, num_keys: int = 1):
+    """The kernels' sort: ``lax.sort`` by default, the bitonic network
+    when ``CAUSE_TPU_SORT=bitonic`` (trace-time switch for hardware
+    A/B)."""
+    if os.environ.get("CAUSE_TPU_SORT", "").strip() == "bitonic":
+        return bitonic_sort(operands, num_keys=num_keys)
+    return lax.sort(tuple(operands), num_keys=num_keys)

@@ -57,6 +57,7 @@ from jax import lax
 from .arrays import I32_MAX, VCLASS_H_HIDE, VCLASS_HIDE
 from .jaxw import _euler_rank, _link_children
 from .jaxw3 import _shift1
+from .bitonic import sort_pairs
 
 __all__ = [
     "merge_weave_kernel_v5",
@@ -149,7 +150,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # ================= A. segment ordering + explode/dedupe =========
     kh = jnp.where(sg_valid, sg_min_hi, BIG)
     kl = jnp.where(sg_valid, sg_min_lo, BIG)
-    s_mh, s_ml, s_src = lax.sort((kh, kl, sidx), num_keys=2)
+    s_mh, s_ml, s_src = sort_pairs((kh, kl, sidx), num_keys=2)
     s_Mh = sg_max_hi[s_src]
     s_Ml = sg_max_lo[s_src]
     s_len = jnp.where(sg_valid[s_src], sg_len[s_src], 0)
@@ -286,7 +287,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
 
     # ================= C. sort tokens, dedupe =======================
     su_src_in = uidx
-    st_hi, st_lo, t_src = lax.sort((t_hi, t_lo, su_src_in), num_keys=2)
+    st_hi, st_lo, t_src = sort_pairs((t_hi, t_lo, su_src_in),
+                                     num_keys=2)
     inv_t = jnp.zeros(U, jnp.int32).at[t_src].set(uidx)
     g = lambda arr: arr[t_src]  # presort field -> sorted order
     sv_len, sv_vc, sv_tsp = g(t_len), g(t_vc), g(t_tsp)
@@ -410,7 +412,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
 
     parent_sort = jnp.where(r_valid & (parent_run >= 0), parent_run, k_max)
     packed = parent_sort * 2 + (~h_special).astype(jnp.int32)
-    sord = jnp.lexsort((-hc, packed))
+    kidx_r = jnp.arange(k_max, dtype=jnp.int32)
+    sord = sort_pairs((packed, -hc, kidx_r), num_keys=2)[2]
     fc, ns = _link_children(sord, parent_sort)
     parent_up = jnp.where(r_valid & (parent_run >= 0), parent_run, -1)
     if euler == "walk":
@@ -449,9 +452,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # preorder-successor run: the run with the next-larger base. base
     # values are node-unit positions (up to N), so find successors by
     # sorting runs on base instead of scattering over node positions.
-    kidx_r = jnp.arange(k_max, dtype=jnp.int32)
     bkey = jnp.where(r_valid, base_run, BIG)
-    b_sorted, b_src = lax.sort((bkey, kidx_r), num_keys=1)
+    b_sorted, b_src = sort_pairs((bkey, kidx_r), num_keys=1)
     succ_in_sorted = jnp.concatenate([
         b_src[1:], jnp.full((1,), -1, jnp.int32)
     ])
@@ -490,7 +492,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # deltas scatter + cumsum reconstructs per-lane values without any
     # full-width gather
     lane_key = jnp.where(keep_t & (rank_tok < N), sv_lane, N)
-    lk, tok_at = lax.sort((lane_key, uidx), num_keys=1)
+    lk, tok_at = sort_pairs((lane_key, uidx), num_keys=1)
     tb_l = rank_tok[tok_at]
     tl_l = jnp.where(lk < N, lk, 0)
     ok_l = lk < N

@@ -29,6 +29,7 @@ run() {
 
 run probe_v5_stages_tpu_r3 python -u scripts/probe_v5_stages.py
 run bench_v5w_tpu_r3 env BENCH_KERNEL=v5w BENCH_TIMEOUT=2400 python bench.py
+run bench_v5_bitonic_tpu_r3 env CAUSE_TPU_SORT=bitonic BENCH_TIMEOUT=2400 python bench.py
 run probe_v4_tpu_r3 python -u scripts/probe_v4.py
 run pallas_probe_tpu_r3 python -u scripts/pallas_probe.py
 run fleet_bench_tpu_r3 python -u scripts/fleet_bench.py

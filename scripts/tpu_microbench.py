@@ -124,6 +124,39 @@ def bench_scatter():
     return _slope(f, (val, idx))
 
 
+def _tok_data():
+    """Token-width shape of the v5 pipeline: [1024 rows, 2252 tokens]."""
+    rng = np.random.default_rng(1)
+    hi = jnp.asarray(rng.integers(0, 1 << 20, (1024, 2252),
+                                  dtype=np.int32))
+    lo = jnp.asarray(rng.integers(0, 1 << 20, (1024, 2252),
+                                  dtype=np.int32))
+    src = jnp.broadcast_to(jnp.arange(2252, dtype=jnp.int32),
+                           (1024, 2252))
+    return hi, lo, src
+
+
+def bench_toksort():
+    """lax.sort, 2 keys + payload, at the v5 token shape — the kernel's
+    C-phase workhorse."""
+    hi, lo, src = _tok_data()
+    return _slope(
+        lambda a, b, s: lax.sort((a, b, s), num_keys=2), (hi, lo, src)
+    )
+
+
+def bench_tokbitonic():
+    """bitonic_sort at the same shape — the CAUSE_TPU_SORT=bitonic
+    alternative (pure elementwise stages)."""
+    from cause_tpu.weaver.bitonic import bitonic_sort
+
+    hi, lo, src = _tok_data()
+    return _slope(
+        lambda a, b, s: bitonic_sort((a, b, s), num_keys=2),
+        (hi, lo, src),
+    )
+
+
 ALL = {
     "elementwise": bench_elementwise,
     "cumsum": bench_cumsum,
@@ -132,6 +165,8 @@ ALL = {
     "lexsort2": bench_lexsort2,
     "lexsort3": bench_lexsort3,
     "scatter": bench_scatter,
+    "toksort": bench_toksort,
+    "tokbitonic": bench_tokbitonic,
 }
 
 

@@ -1,0 +1,50 @@
+"""bitonic_sort must reproduce stable lax.sort exactly (the implicit
+iota key makes the network's output the unique stable order)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import lax
+
+from cause_tpu.weaver.bitonic import bitonic_sort, sort_pairs
+
+I32_MAX = np.iinfo(np.int32).max
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 64, 100, 257])
+@pytest.mark.parametrize("num_keys", [1, 2])
+def test_matches_stable_lax_sort(n, num_keys):
+    rng = np.random.RandomState(n * 10 + num_keys)
+    # few distinct values => plenty of duplicate keys to exercise ties
+    ops = tuple(
+        jnp.asarray(rng.randint(0, 7, size=n).astype(np.int32))
+        for _ in range(num_keys)
+    ) + (jnp.arange(n, dtype=jnp.int32) * 3,)
+    want = lax.sort(ops, num_keys=num_keys, is_stable=True)
+    got = bitonic_sort(ops, num_keys=num_keys)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_batched_and_sentinels():
+    rng = np.random.RandomState(0)
+    hi = rng.randint(0, 50, size=(4, 100)).astype(np.int32)
+    hi[:, 40:] = I32_MAX  # invalid-lane sentinel region
+    lo = rng.randint(0, 50, size=(4, 100)).astype(np.int32)
+    src = np.tile(np.arange(100, dtype=np.int32), (4, 1))
+    ops = (jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(src))
+    want = lax.sort(ops, num_keys=2, is_stable=True)
+    got = bitonic_sort(ops, num_keys=2)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_sort_pairs_env_switch(monkeypatch):
+    ops = (jnp.asarray(np.array([3, 1, 2], np.int32)),
+           jnp.asarray(np.array([10, 11, 12], np.int32)))
+    default = sort_pairs(ops, num_keys=1)
+    monkeypatch.setenv("CAUSE_TPU_SORT", "bitonic")
+    forced = sort_pairs(ops, num_keys=1)
+    for d, f in zip(default, forced):
+        assert np.array_equal(np.asarray(d), np.asarray(f))
