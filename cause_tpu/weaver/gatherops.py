@@ -1,0 +1,56 @@
+"""Switchable 1-D gather strategies for the weave kernels.
+
+TPU has no hardware gather: XLA lowers ``table[idx]`` to per-element
+HBM transactions (~14 ns/element on the round-2 microbenches — the
+single most expensive primitive in the kernel ladder). The
+``rowgather`` strategy instead fetches whole 128-lane rows with
+``take_along_axis`` (a supported fast path) and contracts with a
+one-hot lane mask — 128x data amplification, but every byte streams.
+Which wins depends on the query:table ratio and the backend;
+``CAUSE_TPU_GATHER=rowgather`` flips the kernels at trace time so the
+hardware A/B needs no code change (same discipline as
+``bitonic.sort_pairs``).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["take1d", "rowgather1d"]
+
+_LANE = 128
+_LANE_SHIFT = _LANE.bit_length() - 1
+
+
+def rowgather1d(table, idx):
+    """``table[idx]`` along the last axis via 128-wide row fetch +
+    one-hot contraction. ``table``'s last axis must be a multiple of
+    128 (the kernels' capacity lanes are pow2 >= 1024); ``idx`` must be
+    in-range (callers clip, as they already must for XLA gathers)."""
+    lead = table.shape[:-1]
+    n = table.shape[-1]
+    q = idx.shape[-1]
+    rows = table.reshape(lead + (n // _LANE, _LANE))
+    fetched = jnp.take_along_axis(
+        rows, (idx >> _LANE_SHIFT)[..., None], axis=-2
+    )  # [..., q, 128]
+    onehot = (
+        lax.broadcasted_iota(jnp.int32, idx.shape + (_LANE,),
+                             len(idx.shape))
+        == (idx & (_LANE - 1))[..., None]
+    )
+    return jnp.sum(
+        jnp.where(onehot, fetched, 0), axis=-1
+    ).astype(table.dtype)
+
+
+def take1d(table, idx):
+    """The kernels' gather from a full-width lane table: plain XLA
+    gather by default, ``rowgather1d`` when
+    ``CAUSE_TPU_GATHER=rowgather`` (trace-time switch)."""
+    if os.environ.get("CAUSE_TPU_GATHER", "").strip() == "rowgather":
+        return rowgather1d(table, idx)
+    return table[idx]

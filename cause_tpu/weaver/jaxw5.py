@@ -58,6 +58,7 @@ from .arrays import I32_MAX, VCLASS_H_HIDE, VCLASS_HIDE
 from .jaxw import _euler_rank, _link_children
 from .jaxw3 import _shift1
 from .bitonic import sort_pairs
+from .gatherops import take1d
 
 __all__ = [
     "merge_weave_kernel_v5",
@@ -161,11 +162,11 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     s_va = sg_valid[s_src]
 
     # head body fields (shared by the twin test and the E2 stabs)
-    s_hvc = vclass[jnp.clip(s_lane0, 0, N - 1)]
-    c_lane = cci[jnp.clip(s_lane0, 0, N - 1)]
+    s_hvc = take1d(vclass, jnp.clip(s_lane0, 0, N - 1))
+    c_lane = take1d(cci, jnp.clip(s_lane0, 0, N - 1))
     has_c = s_va & (c_lane >= 0)
-    c_hi = jnp.where(has_c, hi[jnp.clip(c_lane, 0, N - 1)], -1)
-    c_lo = jnp.where(has_c, lo[jnp.clip(c_lane, 0, N - 1)], -1)
+    c_hi = jnp.where(has_c, take1d(hi, jnp.clip(c_lane, 0, N - 1)), -1)
+    c_lo = jnp.where(has_c, take1d(lo, jnp.clip(c_lane, 0, N - 1)), -1)
 
     # twin groups: adjacent exact-equal dense segments dedupe wholesale.
     # Equality covers the endpoints, length, density, the head's value
@@ -257,10 +258,10 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     t_lane = jnp.clip(
         s_lane0[oc] + jnp.where(o_expl, off, 0), 0, N - 1
     )
-    t_hi = jnp.where(u_ok, hi[t_lane], BIG)
-    t_lo = jnp.where(u_ok, lo[t_lane], BIG)
+    t_hi = jnp.where(u_ok, take1d(hi, t_lane), BIG)
+    t_lo = jnp.where(u_ok, take1d(lo, t_lane), BIG)
     t_len = jnp.where(u_ok, jnp.where(o_expl, 1, s_len[oc]), 0)
-    t_vc = jnp.where(u_ok, vclass[t_lane], 0)
+    t_vc = jnp.where(u_ok, take1d(vclass, t_lane), 0)
     t_tail_lane = t_lane + t_len - 1
     t_tsp = jnp.where(
         o_expl, t_vc > 0, s_tsp[oc]
@@ -278,7 +279,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
 
     def token_of_lane(p):
         pc = jnp.clip(p, 0, N - 1)
-        m = jnp.clip(seg[pc], 0, S - 1)
+        m = jnp.clip(take1d(seg, pc), 0, S - 1)
         ss2 = inv_s[m]
         ex = seg_expl_sorted[ss2]
         owner_ss = jnp.where(ex, ss2, gsp[ss2])
@@ -304,7 +305,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
         return _stage_ck(st_hi, keep_t, sv_lane, inv_t)
 
     # ================= D. token cause resolution ====================
-    cl = jnp.where(tva, cci[jnp.clip(sv_lane, 0, N - 1)], -1)
+    cl = jnp.where(tva, take1d(cci, jnp.clip(sv_lane, 0, N - 1)), -1)
     cause_u = token_of_lane(cl)
     cause_su_raw = inv_t[jnp.clip(cause_u, 0, U - 1)]
     # redirect to the kept head of a duplicate token group: dups are
@@ -323,14 +324,14 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     def wcond(c):
         p, i = c
         pc = jnp.clip(p, 0, N - 1)
-        on = rel_t & ~special_t & (p >= 0) & (vclass[pc] > 0)
+        on = rel_t & ~special_t & (p >= 0) & (take1d(vclass, pc) > 0)
         return (i < N) & jnp.any(on)
 
     def wbody(c):
         p, i = c
         pc = jnp.clip(p, 0, N - 1)
-        on = rel_t & ~special_t & (p >= 0) & (vclass[pc] > 0)
-        return jnp.where(on, cci[pc], p), i + 1
+        on = rel_t & ~special_t & (p >= 0) & (take1d(vclass, pc) > 0)
+        return jnp.where(on, take1d(cci, pc), p), i + 1
 
     host_lane, _ = lax.while_loop(wcond, wbody, (cl, jnp.int32(0)))
     host_su = jnp.where(

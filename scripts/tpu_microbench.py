@@ -157,6 +157,32 @@ def bench_tokbitonic():
     )
 
 
+def bench_tokgather():
+    """XLA gather at the v5 query shape: 2252 queries/row from the
+    20480-lane tables, 1024 rows."""
+    rng = np.random.default_rng(2)
+    tab = jnp.asarray(rng.integers(0, 1 << 20, (1024, 20480),
+                                   dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, 20480, (1024, 2252),
+                                   dtype=np.int32))
+    return _slope(
+        lambda t, i: (t, jnp.take_along_axis(t, i, axis=1)), (tab, idx)
+    )
+
+
+def bench_tokrowgather():
+    """rowgather1d at the same query shape — the
+    CAUSE_TPU_GATHER=rowgather alternative."""
+    from cause_tpu.weaver.gatherops import rowgather1d
+
+    rng = np.random.default_rng(2)
+    tab = jnp.asarray(rng.integers(0, 1 << 20, (1024, 20480),
+                                   dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, 20480, (1024, 2252),
+                                   dtype=np.int32))
+    return _slope(lambda t, i: (t, rowgather1d(t, i)), (tab, idx))
+
+
 ALL = {
     "elementwise": bench_elementwise,
     "cumsum": bench_cumsum,
@@ -167,6 +193,8 @@ ALL = {
     "scatter": bench_scatter,
     "toksort": bench_toksort,
     "tokbitonic": bench_tokbitonic,
+    "tokgather": bench_tokgather,
+    "tokrowgather": bench_tokrowgather,
 }
 
 
