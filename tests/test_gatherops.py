@@ -33,9 +33,11 @@ def test_take1d_env_switch(monkeypatch):
     tab = jnp.arange(128, dtype=jnp.int32) * 2
     idx = jnp.asarray(np.array([5, 0, 127], np.int32))
     base = take1d(tab, idx)
-    base_jaxpr = str(jax.make_jaxpr(take1d)(tab, idx))
+    # fresh lambdas: make_jaxpr caches traces on function identity, so
+    # re-tracing take1d itself would return the pre-switch program
+    base_jaxpr = str(jax.make_jaxpr(lambda t, i: take1d(t, i))(tab, idx))
     monkeypatch.setenv("CAUSE_TPU_GATHER", "rowgather")
     forced = take1d(tab, idx)
-    forced_jaxpr = str(jax.make_jaxpr(take1d)(tab, idx))
+    forced_jaxpr = str(jax.make_jaxpr(lambda t, i: take1d(t, i))(tab, idx))
     assert np.array_equal(np.asarray(base), np.asarray(forced))
     assert "iota" in forced_jaxpr and "iota" not in base_jaxpr
