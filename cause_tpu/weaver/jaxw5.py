@@ -508,11 +508,27 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
         0,
     )
     scat = jnp.where(ok_l, tl_l, N)
-    base_n = jnp.zeros(N, jnp.int32).at[scat].add(d_base, mode="drop")
-    lane_n = jnp.zeros(N, jnp.int32).at[scat].add(d_lane, mode="drop")
+    bits = (N - 1).bit_length()
+    if 2 * bits <= 30:
+        # base and lane are both < N, so their delta streams pack into
+        # one int32 place-value pair: ONE scatter + ONE cumsum instead
+        # of two of each (deltas may be negative, but the cumsum is
+        # exact and every prefix total is a valid packed (base, lane))
+        d_pack = d_base * (1 << bits) + d_lane
+        pack_n = jnp.zeros(N, jnp.int32).at[scat].add(d_pack,
+                                                      mode="drop")
+        pack_fill = jnp.cumsum(pack_n)
+        base_fill = pack_fill >> bits
+        lane_fill = pack_fill & ((1 << bits) - 1)
+    else:  # concat width N > 32k (per-tree capacity > 16k): packed
+           # pairs would overflow int32
+        base_n = jnp.zeros(N, jnp.int32).at[scat].add(d_base,
+                                                      mode="drop")
+        lane_n = jnp.zeros(N, jnp.int32).at[scat].add(d_lane,
+                                                      mode="drop")
+        base_fill = jnp.cumsum(base_n)
+        lane_fill = jnp.cumsum(lane_n)
     has_tok = jnp.zeros(N, bool).at[scat].set(True, mode="drop")
-    base_fill = jnp.cumsum(base_n)
-    lane_fill = jnp.cumsum(lane_n)
     lane_idx = jnp.arange(N, dtype=jnp.int32)
 
     # per-lane coverage flags from the segment tables (marshal order =
