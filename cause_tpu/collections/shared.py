@@ -26,6 +26,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .. import pstore
 from .. import util as u
 from ..ids import (
     ROOT_ID,
@@ -119,11 +120,12 @@ WeaveFn = Callable[..., CausalTree]
 
 def assoc_nodes(ct: CausalTree, nodes) -> CausalTree:
     """Add node triples to the canonical ``nodes`` store
-    (shared.cljc:104-110)."""
-    store = dict(ct.nodes)
-    for n in nodes:
-        store[n[0]] = (n[1], n[2])
-    return ct.evolve(nodes=store)
+    (shared.cljc:104-110). Structural sharing past the small-store
+    threshold (pstore.assoc_items) keeps this amortized-sublinear, the
+    reference's persistent-map cost model."""
+    return ct.evolve(nodes=pstore.assoc_items(
+        ct.nodes, {n[0]: (n[1], n[2]) for n in nodes}
+    ))
 
 
 def _spin_one(yarns: Dict[str, list], n) -> None:
@@ -134,7 +136,7 @@ def _spin_one(yarns: Dict[str, list], n) -> None:
     if yarn is None:
         yarns[site] = [n]
     elif yarn[-1][0] < n[0]:
-        yarns[site] = yarn + [n]
+        yarns[site] = pstore.yarn_appended(yarn, n)
     else:
         # expensive sorted splice; avoided on the append fast path above
         yarns[site] = u.insert_sorted(yarn, n)
@@ -223,38 +225,42 @@ def insert(weave_fn: WeaveFn, ct: CausalTree, node, more_nodes_in_tx=None) -> Ca
     if lazy and not chained:
         ensure_weave(weave_fn, ct)
         lazy = False
-    lanes0 = ct.lanes
+    # one fused evolve (dataclass replace is a measurable share of the
+    # per-op cost): nodes, yarns, clock, lanes, and the lazy staleness
+    # all land in a single copy
+    kw = {"nodes": pstore.assoc_items(
+        ct.nodes, {n[0]: (n[1], n[2]) for n in nodes}
+    )}
+    yarns = dict(ct.yarns)
+    _spin_one(yarns, node)
+    if more_nodes_in_tx:
+        for n in more_nodes_in_tx:
+            _spin_one(yarns, n)
+    kw["yarns"] = yarns
     if node[0][0] > ct.lamport_ts:
-        ct = ct.evolve(lamport_ts=node[0][0])
-    ct = assoc_nodes(ct, nodes)
-    ct = spin(ct, node, more_nodes_in_tx)
-    if lanes0 is not None and ct.type == LIST_TYPE:
+        kw["lamport_ts"] = node[0][0]
+    if ct.lanes is not None and ct.type == LIST_TYPE:
         from ..weaver import lanecache
 
-        ct = ct.evolve(lanes=lanecache.extend_view(lanes0, nodes))
+        kw["lanes"] = lanecache.extend_view(ct.lanes, nodes)
     if lazy:
-        return _lazy_after_insert(ct, nodes)
-    return weave_fn(ct, node, more_nodes_in_tx)
-
-
-def _lazy_after_insert(ct: CausalTree, nodes) -> CausalTree:
-    """Skip the weave splice; keep only the tail hint alive.
-
-    Callers guarantee the run chains (each next node causes the
-    previous — non-chaining runs weave eagerly, see ``insert``). The
-    hint survives exactly the append-at-tail case: the run's first
-    cause is the current last weave node. The tail has no woven
-    children by definition, so such a run lands immediately after it
-    and its last node becomes the new tail — for local conj, pastes,
-    AND foreign appends alike. Anything else (mid-weave insert, cons,
-    a stale foreign branch) may displace the last element in ways only
-    a weave scan can see, so the hint dies and the next tail read pays
-    one materialization."""
-    prev_tail = ct.weave[-1][0] if ct.weave is not None else ct.weave_tail
-    new_tail = None
-    if prev_tail is not None and nodes[0][1] == prev_tail:
-        new_tail = nodes[-1][0]
-    return ct.evolve(weave=None, weave_tail=new_tail)
+        # skip the weave splice; keep only the tail hint alive. The
+        # run chains (checked above), so if its first cause is the
+        # current last weave node the whole run lands at the end and
+        # its last node becomes the new tail — for local conj, pastes,
+        # AND foreign appends alike. Anything else may displace the
+        # last element in ways only a weave scan can see: the hint
+        # dies and the next tail read pays one materialization.
+        prev_tail = (ct.weave[-1][0] if ct.weave is not None
+                     else ct.weave_tail)
+        kw["weave"] = None
+        kw["weave_tail"] = (
+            nodes[-1][0]
+            if prev_tail is not None and nodes[0][1] == prev_tail
+            else None
+        )
+        return ct.evolve(**kw)
+    return weave_fn(ct.evolve(**kw), node, more_nodes_in_tx)
 
 
 def ensure_weave(weave_fn: WeaveFn, ct: CausalTree) -> CausalTree:

@@ -1,0 +1,106 @@
+"""OverlayMap/AppendVec must be observationally identical to
+dict/list through every operation the tree core uses."""
+
+import random
+
+import cause_tpu as c
+from cause_tpu.pstore import AppendVec, OverlayMap, assoc_items, yarn_appended
+
+
+def test_overlay_map_protocols():
+    base = {i: i * 2 for i in range(3000)}
+    om = assoc_items(base, {9999: 1})
+    assert isinstance(om, OverlayMap)
+    assert om[9999] == 1 and om[5] == 10
+    assert om.get(123456) is None
+    assert 9999 in om and 5 in om and -1 not in om
+    assert len(om) == 3001
+    assert set(om) == set(base) | {9999}
+    want = dict(base); want[9999] = 1
+    assert om == want and want == om
+    assert dict(om) == want
+    assert sorted(om) == sorted(want)
+    assert om != {**want, 5: 0}
+    assert om != {}
+
+
+def test_overlay_assoc_chain_and_flatten():
+    rng = random.Random(5)
+    store = {i: i for i in range(4000)}
+    mirror = dict(store)
+    for step in range(4000, 4600):
+        store = assoc_items(store, {step: step * 3})
+        mirror[step] = step * 3
+        if step % 97 == 0:
+            assert store == mirror
+    assert dict(store) == mirror
+
+
+def test_overlay_overwrite_flattens():
+    om = assoc_items({i: i for i in range(3000)}, {7777: 1})
+    out = om.assoc({5: 99})  # key exists in base -> flatten
+    assert isinstance(out, dict)
+    assert out[5] == 99 and out[7777] == 1 and len(out) == 3001
+
+
+def test_assoc_items_overwrite_on_big_dict_stays_unambiguous():
+    base = {i: i for i in range(3000)}
+    out = assoc_items(base, {5: 99, 9999: 1})  # 5 overlaps the base
+    assert len(out) == 3001
+    assert out[5] == 99 and out[9999] == 1
+    assert len(set(out)) == 3001  # no duplicated keys in iteration
+    want = dict(base); want.update({5: 99, 9999: 1})
+    assert out == want
+
+
+def test_append_vec_slices_match_list_everywhere():
+    xs = list(range(700))
+    av = AppendVec.from_list(xs)
+    for sl in (slice(690, None), slice(0, 3), slice(100, 500),
+               slice(127, 129), slice(128, 256), slice(None, None),
+               slice(650, 20), slice(-10, None), slice(0, 700, 7)):
+        assert av[sl] == xs[sl], sl
+
+
+def test_append_vec_matches_list():
+    xs = list(range(300))
+    av = AppendVec.from_list(xs)
+    assert list(av) == xs and len(av) == 300
+    assert av[0] == 0 and av[-1] == 299 and av[250] == 250
+    assert av[5:10] == xs[5:10] and av[:7] == xs[:7]
+    assert av == xs and xs == av
+    av2 = av.appended(300)
+    assert av == xs  # unchanged
+    assert list(av2) == xs + [300] and av2[-1] == 300
+    for extra in range(301, 600):
+        av2 = av2.appended(extra)
+    assert list(av2) == list(range(600))
+    assert av2[128] == 128 and av2[511] == 511
+
+
+def test_yarn_appended_upgrades():
+    small = yarn_appended([1, 2], 3)
+    assert small == [1, 2, 3] and isinstance(small, list)
+    big = list(range(3000))
+    up = yarn_appended(big, 3000)
+    assert isinstance(up, AppendVec)
+    assert up[-1] == 3000 and len(up) == 3001
+
+
+def test_big_tree_editing_still_exact():
+    """End-to-end: a tree grown past every threshold renders, merges,
+    and serde-round-trips exactly like its semantics demand."""
+    from cause_tpu import serde
+    from cause_tpu.collections.clist import CausalList
+    from cause_tpu.ids import new_site_id
+
+    cl = c.clist().extend([f"v{i}" for i in range(2500)])
+    for i in range(40):
+        cl = cl.conj(f"c{i}")
+    rep = CausalList(cl.ct.evolve(site_id=new_site_id())).conj("other")
+    merged = cl.merge(rep)
+    edn = merged.causal_to_edn()
+    assert edn[-1] == "other" and len(edn) == 2541
+    back = serde.loads(serde.dumps(merged))
+    assert back.causal_to_edn() == edn
+    assert back.get_nodes() == merged.get_nodes()
