@@ -1,29 +1,35 @@
 """Pallas/Mosaic kernels for the contracted (K-wide) weave phases.
 
-The chain-compressed kernels (jaxw.linearize_v2, jaxw3, jaxw4) shrink
-the causal tree to K runs, but still rank the contracted tree with
-log-depth pointer doubling (``jaxw._euler_rank``) — 13 rounds of
+The chain-compressed kernels (jaxw.linearize_v2, jaxw3, jaxw4, jaxw5)
+shrink the causal tree to K runs, but still rank the contracted tree
+with log-depth pointer doubling (``jaxw._euler_rank``) — ~12 rounds of
 K-wide gathers that TPU profiling showed dominating the residual cost
 (PERF.md): XLA materializes every round as an HBM-width gather pass.
 
 A TPU core walks a K-node tree *sequentially* faster than XLA can
-pointer-double it at batch width: the whole run table fits in VMEM
-(~9 KB at K~2k), a preorder traversal is ~2 visits per run, and each
-visit is a handful of scalar loads — so ``euler_walk`` replaces the
-doubling with one Pallas kernel per replica row (the batch dimension
-arrives via vmap, which maps onto the Pallas grid). Semantics equal
-``_euler_rank``'s weighted preorder base exactly, including the
-convention that unreachable/invalid runs rank at ``total`` (they sort
-behind every kept lane downstream).
+pointer-double it at batch width: the run tables sit in VMEM (~9 KB at
+K~2k), a preorder traversal is ~2 visits per run, and each visit is a
+handful of scalar loads — so ``euler_walk`` replaces the doubling with
+one Pallas program per replica row.
 
-CPU runs (tests, the driver dryrun) execute the same kernel in Pallas
+Mosaic constraints (discovered via AOT ``jax.export`` for tpu, which
+this repo regression-tests — tests/test_pallas_lowering.py — because
+the first design only worked in interpret mode):
+
+- scalar STORES to VMEM are unsupported: the per-visit ``base[cur] =
+  pos`` scatter goes to an SMEM output; dynamic scalar LOADS from VMEM
+  are fine, so the read-only run tables stay in VMEM;
+- a batched (squeezed-leading-dim) block fails the (8, 128) tiling
+  rule, so batching maps onto an explicit grid of (8 rows, K) blocks
+  — ``jax.custom_batching.custom_vmap`` swaps that in when the caller
+  vmaps, which is how the v4/v5 kernels reach it.
+
+CPU runs (tests, the driver dryrun) execute the same kernels in Pallas
 interpret mode — chosen at trace time from the default backend — so
 the suite needs no TPU.
 """
 
 from __future__ import annotations
-
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -35,7 +41,9 @@ try:  # TPU-only module; absent on CPU-only jaxlibs
 except Exception:  # pragma: no cover
     pltpu = None
 
-__all__ = ["euler_walk"]
+__all__ = ["euler_walk", "euler_walk_batch"]
+
+_ROWS = 8  # rows per grid block (the Mosaic sublane tiling unit)
 
 
 def _interpret() -> bool:
@@ -43,17 +51,22 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _specs():
+def _vmem_spec(R, K):
     if pltpu is None:  # pragma: no cover - CPU-only jaxlib
-        any_spec = pl.BlockSpec()
-        return any_spec, any_spec
-    return (pl.BlockSpec(memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pltpu.SMEM))
+        return pl.BlockSpec((R, K), lambda b: (b, 0))
+    return pl.BlockSpec((R, K), lambda b: (b, 0),
+                        memory_space=pltpu.VMEM)
 
 
-def _euler_walk_kernel(fc_ref, ns_ref, parent_ref, w_ref, total_ref,
-                       base_ref):
-    """Preorder walk of one contracted forest.
+def _smem_spec(R, K):
+    if pltpu is None:  # pragma: no cover - CPU-only jaxlib
+        return pl.BlockSpec((R, K), lambda b: (b, 0))
+    return pl.BlockSpec((R, K), lambda b: (b, 0),
+                        memory_space=pltpu.SMEM)
+
+
+def _walk_kernel(fc_ref, ns_ref, parent_ref, w_ref, base_ref):
+    """Preorder walk of each row's contracted forest in the block.
 
     state = (cur, pos, mode): mode 0 visits ``cur`` (stamp base, add
     its weight, descend to first child), mode 1 retreats (next sibling
@@ -61,46 +74,113 @@ def _euler_walk_kernel(fc_ref, ns_ref, parent_ref, w_ref, total_ref,
     iteration; terminates when the retreat climbs past the root (the
     root's parent is -1). Runs never reached from run 0 (invalid /
     overflow slots) keep the ``total`` initialization, matching
-    ``_euler_rank``.
-    """
-    K = fc_ref.shape[1]
-    base_ref[...] = jnp.full((1, K), total_ref[0, 0], jnp.int32)
+    ``_euler_rank``."""
+    R, K = fc_ref.shape
 
-    def cond(state):
-        cur, _pos, _mode, steps = state
-        return (cur >= 0) & (steps < 3 * K + 4)
+    def row(r, _):
+        total = jnp.sum(w_ref[r, :])
 
-    def body(state):
-        cur, pos, mode, steps = state
-        is_visit = mode == 0
+        def init(i, __):
+            base_ref[r, i] = total
+            return 0
 
-        @pl.when(is_visit)
-        def _():
-            base_ref[0, cur] = pos
+        lax.fori_loop(0, K, init, 0)
 
-        child = fc_ref[0, cur]
-        sib = ns_ref[0, cur]
-        par = parent_ref[0, cur]
-        npos = jnp.where(is_visit, pos + w_ref[0, cur], pos)
-        ncur = jnp.where(
-            is_visit,
-            jnp.where(child >= 0, child, cur),
-            jnp.where(sib >= 0, sib, par),
+        def cond(state):
+            cur, _pos, _mode, steps = state
+            return (cur >= 0) & (steps < 3 * K + 4)
+
+        def body(state):
+            cur, pos, mode, steps = state
+            is_visit = mode == 0
+
+            @pl.when(is_visit)
+            def _():
+                base_ref[r, cur] = pos
+
+            child = fc_ref[r, cur]
+            sib = ns_ref[r, cur]
+            par = parent_ref[r, cur]
+            npos = jnp.where(is_visit, pos + w_ref[r, cur], pos)
+            ncur = jnp.where(
+                is_visit,
+                jnp.where(child >= 0, child, cur),
+                jnp.where(sib >= 0, sib, par),
+            )
+            nmode = jnp.where(
+                is_visit,
+                jnp.where(child >= 0, 0, 1),
+                jnp.where(sib >= 0, 0, 1),
+            ).astype(jnp.int32)
+            return ncur, npos, nmode, steps + 1
+
+        lax.while_loop(
+            cond, body,
+            (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
         )
-        nmode = jnp.where(
-            is_visit,
-            jnp.where(child >= 0, 0, 1),
-            jnp.where(sib >= 0, 0, 1),
-        ).astype(jnp.int32)
-        return ncur, npos, nmode, steps + 1
+        return 0
 
-    lax.while_loop(
-        cond, body,
-        (jnp.int32(0), jnp.int32(0), jnp.int32(0), jnp.int32(0)),
+    lax.fori_loop(0, R, row, 0)
+
+
+def euler_walk_batch(fc, ns, parent_run, run_len):
+    """Weighted preorder base per run for a [B, K] batch of contracted
+    forests (grid of _ROWS-row blocks; B pads up to a multiple)."""
+    B, K = fc.shape
+    Bp = -(-B // _ROWS) * _ROWS
+    if Bp != B:
+        # padded rows are empty forests (parent -1 everywhere): the
+        # automaton visits run 0 and immediately terminates
+        pad = ((0, Bp - B), (0, 0))
+        fc = jnp.pad(fc, pad, constant_values=-1)
+        ns = jnp.pad(ns, pad, constant_values=-1)
+        parent_run = jnp.pad(parent_run, pad, constant_values=-1)
+        run_len = jnp.pad(run_len, pad, constant_values=0)
+    out = pl.pallas_call(
+        _walk_kernel,
+        grid=(Bp // _ROWS,),
+        in_specs=[_vmem_spec(_ROWS, K)] * 4,
+        out_specs=_smem_spec(_ROWS, K),
+        out_shape=jax.ShapeDtypeStruct((Bp, K), jnp.int32),
+        interpret=_interpret(),
+    )(fc, ns, parent_run, run_len.astype(jnp.int32))
+    return out[:B]
+
+
+@jax.custom_batching.custom_vmap
+def _euler_walk1(fc, ns, parent_run, run_len):
+    """Single forest: no grid — whole-array blocks take the untiled
+    path, which skips the (8, 128) blocked-shape rule that rejects a
+    squeezed/partial block (verified by the AOT export tests)."""
+    K = fc.shape[0]
+    if pltpu is not None:
+        vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+        smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    else:  # pragma: no cover - CPU-only jaxlib
+        vmem = smem = pl.BlockSpec()
+    out = pl.pallas_call(
+        _walk_kernel,
+        in_specs=[vmem] * 4,
+        out_specs=smem,
+        out_shape=jax.ShapeDtypeStruct((1, K), jnp.int32),
+        interpret=_interpret(),
+    )(
+        fc.reshape(1, K), ns.reshape(1, K), parent_run.reshape(1, K),
+        run_len.astype(jnp.int32).reshape(1, K),
     )
+    return out.reshape(K)
 
 
-@functools.partial(jax.jit, static_argnames="k_max")
+@_euler_walk1.def_vmap
+def _euler_walk1_vmap(axis_size, in_batched, fc, ns, parent_run,
+                      run_len):
+    ops = []
+    for x, b in zip((fc, ns, parent_run, run_len), in_batched):
+        ops.append(x if b else jnp.broadcast_to(
+            x, (axis_size,) + x.shape))
+    return euler_walk_batch(*ops), True
+
+
 def euler_walk(fc, ns, parent_run, run_len, k_max: int):
     """Weighted preorder base per run, for one row's contracted tree.
 
@@ -108,21 +188,6 @@ def euler_walk(fc, ns, parent_run, run_len, k_max: int):
     build (first_child / next_sibling from ``_link_children``, parent
     run ids with -1 at the root/invalid slots, run lengths with 0 at
     invalid slots). Returns ``base`` ``[k_max]`` int32. Under ``vmap``
-    the row dimension becomes the Pallas grid.
-    """
-    vmem, smem = _specs()
-    total = jnp.sum(run_len.astype(jnp.int32)).reshape(1, 1)
-    out = pl.pallas_call(
-        _euler_walk_kernel,
-        in_specs=[vmem, vmem, vmem, vmem, smem],
-        out_specs=vmem,
-        out_shape=jax.ShapeDtypeStruct((1, k_max), jnp.int32),
-        interpret=_interpret(),
-    )(
-        fc.reshape(1, k_max),
-        ns.reshape(1, k_max),
-        parent_run.reshape(1, k_max),
-        run_len.astype(jnp.int32).reshape(1, k_max),
-        total,
-    )
-    return out.reshape(k_max)
+    the batch maps onto the Pallas grid via ``euler_walk_batch``."""
+    assert fc.shape[-1] == k_max, (fc.shape, k_max)
+    return _euler_walk1(fc, ns, parent_run, run_len)

@@ -1,0 +1,127 @@
+"""Mosaic-lowering regression guard for the Pallas kernels.
+
+Interpret mode (what the CPU suite runs) accepts programs the real
+Mosaic compiler rejects — the original euler_walk design passed every
+CPU test yet failed TPU lowering with "Cannot store scalars to VMEM".
+``jax.export`` with platforms=["tpu"] runs the Pallas->Mosaic lowering
+from any backend, so these tests pin compilability without a chip.
+(The final Mosaic->TPU codegen still happens on-device; this catches
+the op-support and tiling-rule class of failure.)"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from cause_tpu.weaver import pallas_ops
+
+
+def _chain_tables(k, n_runs):
+    """A root with a chain of children plus some siblings."""
+    rng = np.random.RandomState(k)
+    parent = np.full(k, -1, np.int32)
+    for i in range(1, n_runs):
+        parent[i] = rng.randint(0, i)
+    w = np.zeros(k, np.int32)
+    w[:n_runs] = rng.randint(1, 5, n_runs)
+    # first-child / next-sibling from the parent table (children in
+    # index order, mirroring _link_children's contract closely enough
+    # for a lowering + smoke-parity test)
+    fc = np.full(k, -1, np.int32)
+    ns = np.full(k, -1, np.int32)
+    last_child = {}
+    for i in range(1, n_runs):
+        p = parent[i]
+        if p in last_child:
+            ns[last_child[p]] = i
+        else:
+            fc[p] = i
+        last_child[p] = i
+    return (jnp.asarray(fc), jnp.asarray(ns), jnp.asarray(parent),
+            jnp.asarray(w))
+
+
+def test_euler_walk_exports_for_tpu(monkeypatch):
+    monkeypatch.setattr(pallas_ops, "_interpret", lambda: False)
+    fc, ns, parent, w = _chain_tables(256, 40)
+
+    def single(a, b, c, d):
+        return pallas_ops.euler_walk(a, b, c, d, 256)
+
+    jax.export.export(jax.jit(single), platforms=["tpu"])(
+        fc, ns, parent, w)
+
+
+def test_euler_walk_batch_exports_for_tpu(monkeypatch):
+    monkeypatch.setattr(pallas_ops, "_interpret", lambda: False)
+    fc, ns, parent, w = _chain_tables(256, 40)
+    B = 12  # non-multiple of the 8-row block: exercises padding
+    batch = tuple(jnp.tile(x, (B, 1)) for x in (fc, ns, parent, w))
+
+    def batched(a, b, c, d):
+        return jax.vmap(
+            lambda e, f, g, h: pallas_ops.euler_walk(e, f, g, h, 256)
+        )(a, b, c, d)
+
+    jax.export.export(jax.jit(batched), platforms=["tpu"])(*batch)
+
+
+def test_v5w_kernel_exports_for_tpu(monkeypatch):
+    """The full v5 kernel with euler='walk' must lower for TPU — the
+    exact program bench.py dispatches under BENCH_KERNEL=v5w."""
+    monkeypatch.setattr(pallas_ops, "_interpret", lambda: False)
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=120, n_div=40, capacity=256, hide_every=8
+    )
+    v5 = benchgen.batched_v5_inputs(batch, 256)
+    u = benchgen.v5_token_budget(v5)
+    args = [jnp.asarray(v5[k]) for k in LANE_KEYS5]
+
+    def f(*a):
+        return batched_merge_weave_v5(*a, u_max=u, k_max=u,
+                                      euler="walk")
+
+    jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+
+
+def test_v5_kernel_exports_for_tpu():
+    """The default v5 program (pure XLA) lowers for TPU too — guards
+    against a jnp construct with no TPU lowering sneaking in."""
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=120, n_div=40, capacity=256, hide_every=8
+    )
+    v5 = benchgen.batched_v5_inputs(batch, 256)
+    u = benchgen.v5_token_budget(v5)
+    args = [jnp.asarray(v5[k]) for k in LANE_KEYS5]
+
+    def f(*a):
+        return batched_merge_weave_v5(*a, u_max=u, k_max=u)
+
+    jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+
+
+def test_walk_parity_vs_doubling_after_redesign():
+    """The SMEM redesign still ranks exactly like _euler_rank."""
+    from cause_tpu.weaver.jaxw import _euler_rank
+
+    fc, ns, parent, w = _chain_tables(128, 31)
+    want, _ = _euler_rank(fc, ns, parent, w)
+    got = pallas_ops.euler_walk(fc, ns, parent, w, 128)
+    assert np.array_equal(np.asarray(want), np.asarray(got))
+    # batched via vmap (the kernels' calling convention)
+    B = 5
+    batch = tuple(jnp.tile(x, (B, 1)) for x in (fc, ns, parent, w))
+    got_b = jax.vmap(
+        lambda a, b, c, d: pallas_ops.euler_walk(a, b, c, d, 128)
+    )(*batch)
+    for r in range(B):
+        assert np.array_equal(np.asarray(want), np.asarray(got_b[r]))
