@@ -19,7 +19,8 @@ import os
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["take1d", "rowgather1d"]
+__all__ = ["take1d", "rowgather1d", "searchsorted_iota_right",
+           "searchsorted_targets_left"]
 
 _LANE = 128
 _LANE_SHIFT = _LANE.bit_length() - 1
@@ -27,11 +28,18 @@ _LANE_SHIFT = _LANE.bit_length() - 1
 
 def rowgather1d(table, idx):
     """``table[idx]`` along the last axis via 128-wide row fetch +
-    one-hot contraction. ``table``'s last axis must be a multiple of
-    128 (the kernels' capacity lanes are pow2 >= 1024); ``idx`` must be
-    in-range (callers clip, as they already must for XLA gathers)."""
+    one-hot contraction. Tables whose last axis is not a multiple of
+    128 (the token/segment tables) are zero-padded up — ``idx`` must be
+    in-range (callers clip, as they already must for XLA gathers), so
+    the padding is never read."""
     lead = table.shape[:-1]
     n = table.shape[-1]
+    if n % _LANE:
+        table = jnp.concatenate([
+            table,
+            jnp.zeros(lead + (_LANE - n % _LANE,), table.dtype),
+        ], axis=-1)
+        n = table.shape[-1]
     q = idx.shape[-1]
     rows = table.reshape(lead + (n // _LANE, _LANE))
     fetched = jnp.take_along_axis(
@@ -54,3 +62,24 @@ def take1d(table, idx):
     if os.environ.get("CAUSE_TPU_GATHER", "").strip() == "rowgather":
         return rowgather1d(table, idx)
     return table[idx]
+
+
+def searchsorted_iota_right(keys_cum, q: int):
+    """``searchsorted(keys_cum, arange(q), side="right")`` for a
+    NON-DECREASING ``keys_cum`` — streaming form: histogram the keys
+    and prefix-sum, no per-query binary search. Always used (it is
+    strictly elementwise + one scatter-add + one cumsum; there is
+    nothing to A/B)."""
+    hist = jnp.zeros(q + 1, jnp.int32).at[
+        jnp.clip(keys_cum, 0, q)
+    ].add(1, mode="drop")
+    return jnp.cumsum(hist[:q]).astype(jnp.int32)
+
+
+def searchsorted_targets_left(keys_cum, k: int):
+    """``searchsorted(keys_cum, arange(1, k + 1), side="left")`` for a
+    NON-DECREASING ``keys_cum`` — streaming form. ``left`` with target
+    t counts keys strictly below t, i.e. keys <= t-1 — the identical
+    histogram prefix as the iota/right case with targets shifted one,
+    so this IS that function under another contract."""
+    return searchsorted_iota_right(keys_cum, k)

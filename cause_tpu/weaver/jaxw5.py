@@ -48,6 +48,7 @@ unflagged; the API paths validate bodies host-side
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
@@ -58,7 +59,8 @@ from .arrays import I32_MAX, VCLASS_H_HIDE, VCLASS_HIDE
 from .jaxw import _euler_rank, _link_children
 from .jaxw3 import _shift1
 from .bitonic import sort_pairs
-from .gatherops import take1d
+from .gatherops import (searchsorted_iota_right,
+                        searchsorted_targets_left, take1d)
 
 __all__ = [
     "merge_weave_kernel_v5",
@@ -100,8 +102,18 @@ def _pair_cummax(hi, lo):
 
 def _pair_search_le(kh, kl, qh, ql, size):
     """For each query id, the rightmost index i in the sorted (kh, kl)
-    arrays with key[i] <= query (-1 if none): a fori binary search at
-    query width."""
+    arrays with key[i] <= query (-1 if none).
+
+    Default: a fori binary search (log2(size) rounds of table
+    gathers). ``CAUSE_TPU_SEARCH=matrix`` (trace-time) counts
+    key<=query over the full [q, size] comparison matrix instead —
+    O(size^2) elementwise work that streams on the VPU with zero
+    random access; at the segment-table widths (size ~512) that is
+    cheaper on TPU than 10 gather rounds."""
+    if os.environ.get("CAUSE_TPU_SEARCH", "").strip() == "matrix":
+        le = _le(kh[None, :], kl[None, :], qh[:, None], ql[:, None])
+        return jnp.sum(le, axis=1).astype(jnp.int32) - 1
+
     steps = 1
     while (1 << steps) < size + 1:
         steps += 1
@@ -110,7 +122,7 @@ def _pair_search_le(kh, kl, qh, ql, size):
         lo_b, hi_b = c
         mid = (lo_b + hi_b + 1) // 2  # invariant: key[lo_b] <= q
         ms = jnp.clip(mid, 0, size - 1)
-        ok = _le(kh[ms], kl[ms], qh, ql)
+        ok = _le(take1d(kh, ms), take1d(kl, ms), qh, ql)
         return jnp.where(ok, mid, lo_b), jnp.where(ok, hi_b, mid - 1)
 
     lo_b, _ = lax.fori_loop(
@@ -152,14 +164,14 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     kh = jnp.where(sg_valid, sg_min_hi, BIG)
     kl = jnp.where(sg_valid, sg_min_lo, BIG)
     s_mh, s_ml, s_src = sort_pairs((kh, kl, sidx), num_keys=2)
-    s_Mh = sg_max_hi[s_src]
-    s_Ml = sg_max_lo[s_src]
-    s_len = jnp.where(sg_valid[s_src], sg_len[s_src], 0)
-    s_lane0 = sg_lane0[s_src]
-    s_dense = sg_dense[s_src]
-    s_tsp = sg_tail_special[s_src]
-    s_vsum = sg_vsum[s_src]
-    s_va = sg_valid[s_src]
+    s_Mh = take1d(sg_max_hi, s_src)
+    s_Ml = take1d(sg_max_lo, s_src)
+    s_va = take1d(sg_valid, s_src)
+    s_len = jnp.where(s_va, take1d(sg_len, s_src), 0)
+    s_lane0 = take1d(sg_lane0, s_src)
+    s_dense = take1d(sg_dense, s_src)
+    s_tsp = take1d(sg_tail_special, s_src)
+    s_vsum = take1d(sg_vsum, s_src)
 
     # head body fields (shared by the twin test and the E2 stabs)
     s_hvc = take1d(vclass, jnp.clip(s_lane0, 0, N - 1))
@@ -209,10 +221,10 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     pmh, pml = _pair_cummax(g_Mh, g_Ml)
     pmh_e, pml_e = _shift1(pmh, -1), _shift1(pml, -1)
     gi = jnp.clip(grp, 0, S - 1)
-    ov_before = _le(s_mh, s_ml, pmh_e[gi], pml_e[gi])
+    ov_before = _le(s_mh, s_ml, take1d(pmh_e, gi), take1d(pml_e, gi))
     nxt_mh = jnp.concatenate([g_mh[1:], jnp.full((1,), BIG, jnp.int32)])
     nxt_ml = jnp.concatenate([g_ml[1:], jnp.full((1,), BIG, jnp.int32)])
-    ov_after = _le(nxt_mh[gi], nxt_ml[gi], s_Mh, s_Ml)
+    ov_after = _le(take1d(nxt_mh, gi), take1d(nxt_ml, gi), s_Mh, s_Ml)
     explode = s_va & (ov_before | ov_after)
 
     # E2: head-cause stabs. Candidate = rightmost group with min <= c.
@@ -222,11 +234,14 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # representative member (first of group; twins agree)
     rep = jnp.full(S, 0, jnp.int32).at[gsl].set(
         jnp.where(grp_start & s_va, sidx, 0), mode="drop")
-    r_len = s_len[rep[pgc]]
-    r_tsp = s_tsp[rep[pgc]]
-    stab = has_c & (pg >= 0) & _le(g_mh[pgc], g_ml[pgc], c_hi, c_lo) & (
-        _lt(c_hi, c_lo, g_Mh[pgc], g_Ml[pgc])
-        | (_eq(c_hi, c_lo, g_Mh[pgc], g_Ml[pgc]) & r_tsp & (r_len > 1))
+    rep_pg = take1d(rep, pgc)
+    r_len = take1d(s_len, rep_pg)
+    r_tsp = take1d(s_tsp, rep_pg)
+    gm_h, gm_l = take1d(g_mh, pgc), take1d(g_ml, pgc)
+    gM_h, gM_l = take1d(g_Mh, pgc), take1d(g_Ml, pgc)
+    stab = has_c & (pg >= 0) & _le(gm_h, gm_l, c_hi, c_lo) & (
+        _lt(c_hi, c_lo, gM_h, gM_l)
+        | (_eq(c_hi, c_lo, gM_h, gM_l) & r_tsp & (r_len > 1))
     )
     g_stabbed = jnp.zeros(S, bool).at[
         jnp.where(stab, pgc, S - 1)
@@ -234,7 +249,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # make the last slot honest (it may have been used as a dump)
     g_stabbed = g_stabbed.at[S - 1].set(
         jnp.any(stab & (pgc == S - 1)))
-    explode = explode | (s_va & g_stabbed[gi])
+    explode = explode | (s_va & take1d(g_stabbed, gi))
 
     twin_drop = same_prev & ~explode
     survive = s_va & ~explode & ~twin_drop
@@ -251,20 +266,20 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     u_ok = uidx < jnp.minimum(n_tok, U)
     overflow_u = n_tok > U
 
-    owner = jnp.searchsorted(tc_cum, uidx, side="right").astype(jnp.int32)
+    owner = searchsorted_iota_right(tc_cum, U)
     oc = jnp.clip(owner, 0, S - 1)
-    off = uidx - tb[oc]
-    o_expl = s_va[oc] & (~survive[oc])
+    off = uidx - take1d(tb, oc)
+    o_expl = take1d(s_va, oc) & (~take1d(survive, oc))
     t_lane = jnp.clip(
-        s_lane0[oc] + jnp.where(o_expl, off, 0), 0, N - 1
+        take1d(s_lane0, oc) + jnp.where(o_expl, off, 0), 0, N - 1
     )
     t_hi = jnp.where(u_ok, take1d(hi, t_lane), BIG)
     t_lo = jnp.where(u_ok, take1d(lo, t_lane), BIG)
-    t_len = jnp.where(u_ok, jnp.where(o_expl, 1, s_len[oc]), 0)
+    t_len = jnp.where(u_ok, jnp.where(o_expl, 1, take1d(s_len, oc)), 0)
     t_vc = jnp.where(u_ok, take1d(vclass, t_lane), 0)
     t_tail_lane = t_lane + t_len - 1
     t_tsp = jnp.where(
-        o_expl, t_vc > 0, s_tsp[oc]
+        o_expl, t_vc > 0, take1d(s_tsp, oc)
     ) & u_ok
     if stage == "B":
         return _stage_ck(t_hi, t_lo, t_len, t_tsp)
@@ -280,18 +295,18 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     def token_of_lane(p):
         pc = jnp.clip(p, 0, N - 1)
         m = jnp.clip(take1d(seg, pc), 0, S - 1)
-        ss2 = inv_s[m]
-        ex = seg_expl_sorted[ss2]
-        owner_ss = jnp.where(ex, ss2, gsp[ss2])
-        return (tb[owner_ss]
-                + jnp.where(ex, pc - sg_lane0[m], 0)).astype(jnp.int32)
+        ss2 = take1d(inv_s, m)
+        ex = take1d(seg_expl_sorted, ss2)
+        owner_ss = jnp.where(ex, ss2, take1d(gsp, ss2))
+        return (take1d(tb, owner_ss)
+                + jnp.where(ex, pc - take1d(sg_lane0, m), 0)).astype(jnp.int32)
 
     # ================= C. sort tokens, dedupe =======================
     su_src_in = uidx
     st_hi, st_lo, t_src = sort_pairs((t_hi, t_lo, su_src_in),
                                      num_keys=2)
     inv_t = jnp.zeros(U, jnp.int32).at[t_src].set(uidx)
-    g = lambda arr: arr[t_src]  # presort field -> sorted order
+    g = lambda arr: take1d(arr, t_src)  # presort field -> sorted order
     sv_len, sv_vc, sv_tsp = g(t_len), g(t_vc), g(t_tsp)
     sv_lane, sv_tail_lane = g(t_lane), g(t_tail_lane)
 
@@ -307,12 +322,12 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # ================= D. token cause resolution ====================
     cl = jnp.where(tva, take1d(cci, jnp.clip(sv_lane, 0, N - 1)), -1)
     cause_u = token_of_lane(cl)
-    cause_su_raw = inv_t[jnp.clip(cause_u, 0, U - 1)]
+    cause_su_raw = take1d(inv_t, jnp.clip(cause_u, 0, U - 1))
     # redirect to the kept head of a duplicate token group: dups are
     # adjacent after the sort, so a kept-head fill redirects them
     thead = lax.cummax(jnp.where(keep_t, uidx, -1))
     cause_su = jnp.where(
-        cl >= 0, thead[jnp.clip(cause_su_raw, 0, U - 1)], 0
+        cl >= 0, take1d(thead, jnp.clip(cause_su_raw, 0, U - 1)), 0
     ).astype(jnp.int32)
 
     special_t = keep_t & (sv_vc > 0)
@@ -336,8 +351,10 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     host_lane, _ = lax.while_loop(wcond, wbody, (cl, jnp.int32(0)))
     host_su = jnp.where(
         host_lane >= 0,
-        thead[jnp.clip(inv_t[jnp.clip(token_of_lane(host_lane), 0, U - 1)],
-                       0, U - 1)],
+        take1d(thead,
+               jnp.clip(take1d(inv_t,
+                               jnp.clip(token_of_lane(host_lane),
+                                        0, U - 1)), 0, U - 1)),
         0,
     ).astype(jnp.int32)
     parent_su = jnp.where(special_t, cause_su, host_su)
@@ -387,23 +404,21 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     overflow_k = n_runs > k_max
 
     targets = jnp.arange(1, k_max + 1, dtype=jnp.int32)
-    head_tok = jnp.searchsorted(rs_cum, targets, side="left").astype(
-        jnp.int32
-    )
+    head_tok = searchsorted_targets_left(rs_cum, k_max)
     r_valid = targets <= jnp.minimum(n_runs, k_max)
     hc = jnp.clip(head_tok, 0, U - 1)
 
     h_parent = jnp.where(
-        irregular[hc], parent_su[hc],
-        jnp.where(adj[hc], prev_kept[hc], -1),
+        take1d(irregular, hc), take1d(parent_su, hc),
+        jnp.where(take1d(adj, hc), take1d(prev_kept, hc), -1),
     )
-    h_parent = jnp.where(r_valid & ~is_root_t[hc], h_parent, -1)
+    h_parent = jnp.where(r_valid & ~take1d(is_root_t, hc), h_parent, -1)
     parent_run = jnp.where(
-        h_parent >= 0, run_id[jnp.clip(h_parent, 0, U - 1)], -1
+        h_parent >= 0, take1d(run_id, jnp.clip(h_parent, 0, U - 1)), -1
     ).astype(jnp.int32)
 
-    h_special = special_t[hc]
-    h_w = wstart[hc]
+    h_special = take1d(special_t, hc)
+    h_w = take1d(wstart, hc)
     nxt_w = jnp.concatenate([h_w[1:], h_w[:1]])
     run_w = jnp.where(
         r_valid,
@@ -447,7 +462,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     hideish = (sv_vc == VCLASS_HIDE) | (sv_vc == VCLASS_H_HIDE)
     kg = glued & hideish
     vict_inrun = jnp.where(
-        kg, sv_tail_lane[jnp.clip(prev_kept, 0, U - 1)], N
+        kg, take1d(sv_tail_lane, jnp.clip(prev_kept, 0, U - 1)), N
     )
 
     # preorder-successor run: the run with the next-larger base. base
@@ -466,22 +481,23 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     )
     succ_run = jnp.where(r_valid, succ_of, -1)
     s_c = jnp.clip(
-        jnp.where(succ_run >= 0, hc[jnp.clip(succ_run, 0, k_max - 1)], 0),
+        jnp.where(succ_run >= 0,
+                  take1d(hc, jnp.clip(succ_run, 0, k_max - 1)), 0),
         0, U - 1,
     )
-    s_is_hide = (succ_run >= 0) & hideish[s_c]
+    s_is_hide = (succ_run >= 0) & take1d(hideish, s_c)
     nxt_head = jnp.concatenate([hc[1:], hc[:1]])
     tail_tok = jnp.where(
         targets == n_runs,
         jnp.maximum(sp_pack[-1] >> 1, 0),
-        prev_kept[jnp.clip(nxt_head, 0, U - 1)],
+        take1d(prev_kept, jnp.clip(nxt_head, 0, U - 1)),
     ).astype(jnp.int32)
     t_cc = jnp.clip(tail_tok, 0, U - 1)
     # succ head's cause must BE the run's tail node — compared at
     # token level (cause_su is duplicate-redirected; a hide arriving
     # from another replica names its own dropped copy of the tail)
-    kill_tail = r_valid & s_is_hide & (cause_su[s_c] == tail_tok)
-    vict_tail = jnp.where(kill_tail, sv_tail_lane[t_cc], N)
+    kill_tail = r_valid & s_is_hide & (take1d(cause_su, s_c) == tail_tok)
+    vict_tail = jnp.where(kill_tail, take1d(sv_tail_lane, t_cc), N)
     if stage == "E":
         # conflict included so prefix increments stay strictly
         # cumulative over stage D's reduction
@@ -494,7 +510,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # full-width gather
     lane_key = jnp.where(keep_t & (rank_tok < N), sv_lane, N)
     lk, tok_at = sort_pairs((lane_key, uidx), num_keys=1)
-    tb_l = rank_tok[tok_at]
+    tb_l = take1d(rank_tok, tok_at)
     tl_l = jnp.where(lk < N, lk, 0)
     ok_l = lk < N
     d_base = jnp.where(
@@ -535,7 +551,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # ascending lane order): covered = lane belongs to a token that is
     # kept, either via its own token (exploded) or its segment's token
     cov_cnt = jnp.zeros(N + 1, jnp.int32)
-    seg_cov = sg_valid & survive[inv_s]
+    seg_cov = sg_valid & take1d(survive, inv_s)
     cov_cnt = cov_cnt.at[
         jnp.where(seg_cov, sg_lane0, N)
     ].add(1, mode="drop")

@@ -24,14 +24,16 @@ declare -A CMDS=(
   [bench_v5w_tpu_r3]="env BENCH_KERNEL=v5w BENCH_TIMEOUT=2400 python bench.py"
   [bench_v5_bitonic_tpu_r3]="env CAUSE_TPU_SORT=bitonic BENCH_TIMEOUT=2400 python bench.py"
   [bench_v5_rowgather_tpu_r3]="env CAUSE_TPU_GATHER=rowgather BENCH_TIMEOUT=2400 python bench.py"
+  [bench_v5_allstream_tpu_r3]="env CAUSE_TPU_GATHER=rowgather CAUSE_TPU_SORT=bitonic CAUSE_TPU_SEARCH=matrix BENCH_TIMEOUT=2400 python bench.py"
   [probe_v4_tpu_r3]="python -u scripts/probe_v4.py"
   [pallas_probe_tpu_r3]="python -u scripts/pallas_probe.py"
   [fleet_bench_tpu_r3]="python -u scripts/fleet_bench.py"
   [microbench_tpu_r3]="python -u scripts/tpu_microbench.py"
 )
-ORDER="probe_v5_stages_tpu_r3 microbench_tpu_r3 bench_v5w_tpu_r3 \
-bench_v5_bitonic_tpu_r3 bench_v5_rowgather_tpu_r3 \
-probe_v4_tpu_r3 pallas_probe_tpu_r3 fleet_bench_tpu_r3"
+ORDER="bench_v5_allstream_tpu_r3 probe_v5_stages_tpu_r3 \
+microbench_tpu_r3 bench_v5_rowgather_tpu_r3 bench_v5_bitonic_tpu_r3 \
+bench_v5w_tpu_r3 probe_v4_tpu_r3 pallas_probe_tpu_r3 \
+fleet_bench_tpu_r3"
 
 deadline=$(( $(date +%s) + 86400 ))
 while [ "$(date +%s)" -lt "$deadline" ]; do

@@ -89,6 +89,33 @@ def test_v5w_kernel_exports_for_tpu(monkeypatch):
     jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
 
 
+def test_v5_allstream_exports_for_tpu(monkeypatch):
+    """The full streaming configuration (rowgather + bitonic + matrix
+    search) must lower for TPU — the watcher's headline candidate."""
+    monkeypatch.setenv("CAUSE_TPU_GATHER", "rowgather")
+    monkeypatch.setenv("CAUSE_TPU_SORT", "bitonic")
+    monkeypatch.setenv("CAUSE_TPU_SEARCH", "matrix")
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=120, n_div=40, capacity=256, hide_every=8
+    )
+    v5 = benchgen.batched_v5_inputs(batch, 256)
+    u = benchgen.v5_token_budget(v5)
+    args = [jnp.asarray(v5[k]) for k in LANE_KEYS5]
+
+    def f(*a):
+        return batched_merge_weave_v5(*a, u_max=u, k_max=u)
+
+    batched_merge_weave_v5.clear_cache()
+    try:
+        jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+    finally:
+        batched_merge_weave_v5.clear_cache()
+
+
 def test_v5_kernel_exports_for_tpu():
     """The default v5 program (pure XLA) lowers for TPU too — guards
     against a jnp construct with no TPU lowering sneaking in."""
