@@ -188,7 +188,15 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
     take the ``LANE_KEYS`` lanes, v4/v4w the ``LANE_KEYS4`` lanes, v5
     the ``LANE_KEYS5`` lanes.
     """
-    key = (k_max, kernel if k_max > 0 else "v1", u_max)
+    import os as _os
+
+    # the CAUSE_TPU_* streaming switches are read at TRACE time inside
+    # the kernels, so they are part of the program identity
+    switches = tuple(
+        _os.environ.get(k, "") for k in
+        ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER", "CAUSE_TPU_SEARCH")
+    )
+    key = (k_max, kernel if k_max > 0 else "v1", u_max, switches)
     program = _scalar_programs.get(key)
     if program is None:
         import functools
