@@ -263,6 +263,12 @@ def measure(platform: str) -> dict:
         os.environ["CAUSE_TPU_SORT"] = "bitonic"
         os.environ["CAUSE_TPU_GATHER"] = "rowgather"
         os.environ["CAUSE_TPU_SEARCH"] = "matrix"
+        # the switches are read at TRACE time inside module-level
+        # jitted kernels whose caches key on avals only — without a
+        # cache clear the "allstream" attempt would silently re-trace
+        # to the already-cached default program and A/B noise against
+        # itself (the outer merge_wave_scalar key alone is NOT enough)
+        jax.clear_caches()
         try:
             step(k_max, kernel)  # compile + overflow check
             alt_amortized = float(np.median(
@@ -287,6 +293,7 @@ def measure(platform: str) -> dict:
             for k in ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER",
                       "CAUSE_TPU_SEARCH"):
                 os.environ.pop(k, None)
+            jax.clear_caches()  # stale switch-traced programs
 
     tag = os.environ.get("BENCH_TAG") or real_platform
     # the 100 ms target is defined at full size on TPU; a smoke-size or
@@ -344,8 +351,13 @@ def main() -> None:
         if platform == "cpu":
             # a forced Pallas-walk kernel runs in interpret mode off-TPU
             # — sequential per row at full size, it would burn the whole
-            # fallback timeout; the CPU evidence uses the default ladder
-            env.pop("BENCH_KERNEL", None)
+            # fallback timeout; likewise the TPU-specific streaming
+            # switches (128x rowgather amplification, matrix search)
+            # are pessimizations on CPU. The CPU evidence always uses
+            # the default ladder and default strategies.
+            for k in ("BENCH_KERNEL", "CAUSE_TPU_SORT",
+                      "CAUSE_TPU_GATHER", "CAUSE_TPU_SEARCH"):
+                env.pop(k, None)
         got = _run_abandonable([sys.executable, __file__], env, timeout)
         if got is None:
             errors.append(f"{platform}: abandoned after {timeout:.0f}s")

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .gatherops import take1d
 from .arrays import (
     I32_MAX,
     NodeArrays,
@@ -81,7 +82,7 @@ def _link_children(order, parent_sort):
     parent key, link the per-parent child lists: returns
     (first_child, next_sibling) as [N] lane-index arrays (-1 = none)."""
     N = parent_sort.shape[0]
-    p = parent_sort[order]
+    p = take1d(parent_sort, order)
     is_start = jnp.concatenate([jnp.ones((1,), bool), p[1:] != p[:-1]])
     same_parent_next = jnp.concatenate([p[1:] == p[:-1], jnp.zeros((1,), bool)])
     succ_in_sort = jnp.concatenate([order[1:], jnp.zeros((1,), order.dtype)])
@@ -124,7 +125,7 @@ def _euler_rank(first_child, next_sibling, parent_up, weights):
 
     def body(_, carry):
         val, nx = carry
-        return val + val[nx], nx[nx]
+        return val + take1d(val, nx), take1d(nx, nx)
 
     val, _ = lax.fori_loop(0, steps, body, (w, nxt))
     s_down = val[:N]   # weight at-or-after d(i) in the tour
