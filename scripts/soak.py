@@ -66,6 +66,11 @@ def list_round(rng):
     ))
     cl.ct.lanes.segments()
     pure = CausalList(cl.ct.evolve(weaver="pure"))
+    if rng.random() < 0.5:
+        # half the rounds run the device handle in lazy-weave mode:
+        # stale weaves + tail hints must stay observationally equal to
+        # the eager pure oracle through every op and serde round-trip
+        cl = CausalList(cl.ct.evolve(lazy_weave=True))
     fork = None
     for step in range(rng.randrange(4, 25)):
         op = rng.randrange(8)
@@ -76,8 +81,8 @@ def list_round(rng):
             cl, pure = cl.conj(f"c{step}"), pure.conj(f"c{step}")
         elif op == 2:
             cl, pure = cl.cons(f"f{step}"), pure.cons(f"f{step}")
-        elif op == 3 and len(cl.ct.weave) > 2:
-            target = rng.choice(cl.ct.weave[1:])[0]
+        elif op == 3 and len(cl.get_weave()) > 2:
+            target = rng.choice(cl.get_weave()[1:])[0]
             cl = cl.append(target, c.hide)
             pure = pure.append(target, c.hide)
         elif op == 4:
