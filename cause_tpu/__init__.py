@@ -140,6 +140,33 @@ def get_weave(causal):
     return causal.get_weave()
 
 
+def content_digest(causal) -> int:
+    """Canonical convergence digest of a collection's node bag:
+    order-free, process-free, interner-free — two replicas anywhere
+    (different hosts, different site-rank interners, different insert
+    orders) digest equal iff their node sets are equal. Per-node
+    blake2b over the canonical serde encoding, combined by a
+    permutation-invariant sum. The device-side
+    ``parallel.mesh.replica_digest`` is the fast intra-process twin;
+    this one is the cross-host check (sync fleets compare it after
+    anti-entropy rounds). No reference analogue (convergence there is
+    checked by comparing whole trees)."""
+    import hashlib
+    import json as _json
+
+    from . import serde as _serde
+
+    total = 0
+    # encode_node_items already emits JSON-able tagged data (the wire
+    # and checkpoint encoding) — hash exactly those bytes, one
+    # json.dumps each, no second to_data pass
+    for item in _serde.encode_node_items(causal.get_nodes()):
+        blob = _json.dumps(item, allow_nan=False).encode()
+        h = hashlib.blake2b(blob, digest_size=8).digest()
+        total = (total + int.from_bytes(h, "big")) & (2**64 - 1)
+    return total
+
+
 def blame(causal):
     """Who wrote what, when: the visible content annotated with each
     element's author site and lamport time. Every node carries complete
@@ -259,6 +286,7 @@ __all__ = [
     "merge",
     "merge_all",
     "blame",
+    "content_digest",
     "get_weave",
     "get_nodes",
     "causal_to_edn",

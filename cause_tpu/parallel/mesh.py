@@ -68,7 +68,13 @@ def replica_digest(hi_sorted, lo_sorted, rank, visible):
     Each lane goes through a murmur3-style avalanche before the
     permutation-invariant sum: a plain xor-of-products mix let rows
     whose lanes differ only in site ranks cancel into collisions
-    (observed in the wild at 4 rows)."""
+    (observed in the wild at 4 rows).
+
+    SCOPE: comparable only within one interner domain (one process /
+    one fleet session) — hi/lo encode interner-assigned site RANKS,
+    which are first-seen-order per process. Convergence checks ACROSS
+    hosts use the canonical, rank-free ``cause_tpu.content_digest``
+    instead (the two-process distributed test does)."""
     m = rank.shape[0]
     kept = rank < m
     pos = jnp.where(kept, rank.astype(jnp.uint32), jnp.uint32(0))

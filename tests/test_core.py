@@ -73,3 +73,20 @@ def test_blame_projects_authorship():
     bb = c.blame(cb)
     root_blame = bb[c.get_uuid(c.get_collection(cb))]
     assert root_blame[K("k")][0] == 1
+
+
+def test_content_digest_canonical():
+    """Order-free, process-free convergence digest: equal node bags
+    digest equal regardless of op order; different bags differ."""
+    a = c.clist("x", "y")
+    from cause_tpu.collections.clist import CausalList
+    from cause_tpu.ids import new_site_id
+
+    r1 = CausalList(a.ct.evolve(site_id=new_site_id())).conj("1")
+    r2 = CausalList(a.ct.evolve(site_id=new_site_id())).conj("2")
+    m12 = r1.merge(r2)
+    m21 = r2.merge(r1)
+    assert c.content_digest(m12) == c.content_digest(m21)
+    assert c.content_digest(m12) != c.content_digest(r1)
+    # serde round-trip preserves the digest (canonical encoding)
+    assert c.content_digest(c.loads(c.dumps(m12))) == c.content_digest(m12)
