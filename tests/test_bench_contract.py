@@ -1,0 +1,56 @@
+"""The driver contract for bench.py: whatever happens, it exits 0 and
+prints ONE parseable JSON line with the required keys. This is the
+artifact the round is judged on (BENCH_r{N}.json), so the contract
+gets a real subprocess test, not just code review."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(extra_env):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("BENCH_", "CAUSE_TPU_"))}
+    env.update(PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+               **extra_env)
+    # aligned with bench.py's own worst case: two CPU attempts at
+    # CPU_TIMEOUT_S=900 each, plus margin
+    out = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "bench.py")],
+        capture_output=True, text=True, timeout=2000, env=env,
+        cwd=_ROOT,
+    )
+    assert out.returncode == 0, out.stderr[-1500:]
+    lines = [l for l in out.stdout.splitlines() if l.strip()]
+    assert lines, out.stderr[-1500:]
+    rec = json.loads(lines[-1])
+    for key in ("metric", "value", "unit", "vs_baseline", "platform",
+                "kernel", "config"):
+        assert key in rec, (key, rec)
+    assert rec["value"] and rec["value"] > 0
+    assert rec["unit"] == "ms"
+    return rec
+
+
+def test_smoke_contract_cpu():
+    rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_SMOKE": "1"})
+    assert rec["platform"] == "cpu-forced"
+    assert "smoke size" in rec["metric"]
+    # CPU/smoke runs must never claim the TPU-defined target
+    assert rec["vs_baseline"] == 0.0
+
+
+def test_forced_kernel_is_stripped_on_cpu():
+    rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_SMOKE": "1",
+                "BENCH_KERNEL": "v5w", "CAUSE_TPU_SORT": "bitonic"})
+    # the interpret-mode walk and TPU-specific streaming switches must
+    # not leak into the CPU evidence path
+    assert rec["kernel"] == "v5"
+    assert rec["config"] == "default"
