@@ -38,7 +38,16 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--allstream", action="store_true",
+                    help="profile the streaming configuration "
+                         "(rowgather + bitonic + matrix search)")
     a = ap.parse_args()
+    if a.allstream:
+        import os
+
+        os.environ["CAUSE_TPU_SORT"] = "bitonic"
+        os.environ["CAUSE_TPU_GATHER"] = "rowgather"
+        os.environ["CAUSE_TPU_SEARCH"] = "matrix"
     if a.smoke:
         B, NB, ND, CAP = 8, 800, 100, 1024
     else:

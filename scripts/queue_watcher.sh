@@ -21,6 +21,7 @@ ok() {  # item succeeded? bench items need a tpu-tagged JSON line;
 
 declare -A CMDS=(
   [probe_v5_stages_tpu_r3]="python -u scripts/probe_v5_stages.py"
+  [probe_v5_stages_allstream_tpu_r3]="python -u scripts/probe_v5_stages.py --allstream"
   [bench_v5w_tpu_r3]="env BENCH_KERNEL=v5w BENCH_NO_ALLSTREAM=1 BENCH_TIMEOUT=2400 python bench.py"
   [bench_v5_bitonic_tpu_r3]="env CAUSE_TPU_SORT=bitonic BENCH_TIMEOUT=2400 python bench.py"
   [bench_v5_rowgather_tpu_r3]="env CAUSE_TPU_GATHER=rowgather BENCH_TIMEOUT=2400 python bench.py"
@@ -31,6 +32,7 @@ declare -A CMDS=(
   [microbench_tpu_r3]="python -u scripts/tpu_microbench.py"
 )
 ORDER="bench_v5_allstream_tpu_r3 probe_v5_stages_tpu_r3 \
+probe_v5_stages_allstream_tpu_r3 \
 microbench_tpu_r3 bench_v5_rowgather_tpu_r3 bench_v5_bitonic_tpu_r3 \
 bench_v5w_tpu_r3 probe_v4_tpu_r3 pallas_probe_tpu_r3 \
 fleet_bench_tpu_r3"
