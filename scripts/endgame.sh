@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-3 endgame sequencer: wait for the soak + slow suite to drain,
+# then take clean quiet-box measurements for PERF.md.
+set -u
+cd "$(dirname "$0")/.."
+
+# 1. wait for the soak and the full slow suite (background pytest)
+while pgrep -f "soak.py --minutes" > /dev/null 2>&1; do sleep 60; done
+while pgrep -f "pytest tests/ -q -m slow" > /dev/null 2>&1; do sleep 60; done
+echo "endgame: [$(date -u +%H:%M:%S)] box quiet; measuring" >&2
+
+export PALLAS_AXON_POOL_IPS=
+export JAX_PLATFORMS=cpu
+
+# 2. host benchmark table (configs 1-4 + lazy row), quiet box
+python -m cause_tpu.benchmarks > measurements/hostbench_quiet_r3.log 2>&1
+
+# 3. end-to-end API wave at full scale with lazy replicas + pstore
+python -u scripts/api_bench.py --wave 1024 --lazy --cpu \
+  > measurements/api_wave1024_lazy_quiet_r3.log 2>&1
+
+# 4. pairwise API merge timings (pure/native/jax)
+python -u scripts/api_bench.py --cpu \
+  > measurements/api_pairwise_quiet_r3.log 2>&1
+
+echo "endgame: [$(date -u +%H:%M:%S)] done" >&2
