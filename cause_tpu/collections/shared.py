@@ -84,7 +84,12 @@ class CausalTree:
     site_id: str
     nodes: Dict[tuple, tuple]
     yarns: Dict[str, list]
-    weave: Any
+    # CACHE, excluded from equality: ``nodes`` (with ``yarns``) fully
+    # determines the weave — ``ensure_weave`` rebuilds it from them —
+    # and under ``lazy_weave`` a stale tree (weave=None) must still
+    # compare equal to its materialized twin at the raw-dataclass
+    # level, not only through ListTreeHandle.__eq__.
+    weave: Any = field(compare=False)
     weaver: str = "pure"
     # IObj/IMeta analogue (list.cljc:97-101, map.cljc:159-163): an
     # arbitrary attachment that never affects equality and is not

@@ -34,7 +34,10 @@ _SMALL = 2048
 
 class OverlayMap(Mapping):
     """Immutable mapping = base dict + small extra dict (disjoint
-    keys; ``assoc`` flattens on overlap, so lookups never shadow)."""
+    keys; ``assoc`` flattens on overlap, so lookups never shadow).
+
+    The base is aliased, not copied: the constructor's caller promises
+    the base dict is frozen from here on (see assoc_items)."""
 
     __slots__ = ("_base", "_extra")
 
@@ -191,7 +194,14 @@ class AppendVec(Sequence):
 def assoc_items(store: Mapping, items: dict) -> Mapping:
     """``store`` plus ``items``, picking the cheapest representation:
     plain-dict copy while small, OverlayMap structural sharing once the
-    copy would dominate the op."""
+    copy would dominate the op.
+
+    ALIASING INVARIANT: past the small-store threshold the caller's
+    ``store`` is wrapped as the OverlayMap base WITHOUT copying — it
+    must never be mutated in place afterwards or every derived tree
+    silently corrupts. All nodes stores in this codebase are treated
+    as frozen (union_nodes_many copies first); new callers must keep
+    that contract."""
     if isinstance(store, OverlayMap):
         return store.assoc(items)
     if len(store) < _SMALL or any(k in store for k in items):

@@ -272,6 +272,16 @@ def main():
         jax.config.update("jax_platforms", "cpu")
     import jax
 
+    # bounded backend claim when the round-4 watcher drives this
+    # script (HARVEST_CLAIM_DEADLINE; no-op interactively): a wedged
+    # tunnel claim must not outlive the watcher's deadline, and the
+    # guard disarms before any compile can be in flight
+    import claimguard
+
+    disarm = claimguard.arm("api_bench")
+    jax.devices()
+    disarm()
+
     if args.maps:
         map_bench(args)
         return

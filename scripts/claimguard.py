@@ -1,0 +1,43 @@
+"""Pre-compile backend-claim watchdog shared by the measurement
+scripts the round-4 watcher launches (harvest.py, api_bench.py).
+
+The axon tunnel claim (the first ``jax.devices()``) can hang 28-50
+minutes, occasionally indefinitely. A script the watcher is waiting on
+must not hold the claim past the watcher's deadline — but it also must
+never be killed mid-compile (round-2 lesson: that can wedge the tunnel
+server). So: arm a watchdog BEFORE backend init and disarm the moment
+the backend answers, before any compile can be in flight; if the claim
+exceeds ``HARVEST_CLAIM_DEADLINE`` seconds the process hard-exits
+(rc=3) while still provably pre-compile.
+
+Usage::
+
+    disarm = claimguard.arm()
+    plat = jax.devices()[0].platform   # the blocking claim
+    disarm()
+
+No-op when HARVEST_CLAIM_DEADLINE is unset/0 (interactive runs).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+
+def arm(tag: str = "claimguard"):
+    deadline = float(os.environ.get("HARVEST_CLAIM_DEADLINE", "0") or 0)
+    if deadline <= 0:
+        return lambda: None
+    done = threading.Event()
+
+    def _watch():
+        if not done.wait(deadline):
+            print(f"{tag}: backend claim past {deadline:.0f}s; "
+                  "exiting before any compile starts", file=sys.stderr,
+                  flush=True)
+            os._exit(3)
+
+    threading.Thread(target=_watch, daemon=True).start()
+    return done.set

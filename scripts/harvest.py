@@ -101,27 +101,13 @@ def main() -> None:
     for k in SWITCHES:
         os.environ.pop(k, None)
 
-    # Claim watchdog: the blocking tunnel claim (jax.devices()) can hang
-    # ~28-50 min per round-3 observation, and occasionally wedge outright
-    # — which would hold the axon claim past the watcher's deadline into
-    # the driver's round-end bench. Hard-exit if the backend hasn't
-    # confirmed within the deadline. This fires only BEFORE any compile
-    # is in flight (it is disarmed the moment the backend answers), so
-    # it cannot reproduce the round-2 killed-mid-compile tunnel wedge.
-    import threading
+    # Bounded backend claim (shared guard; see claimguard docstring):
+    # hard-exit if the tunnel claim wedges past HARVEST_CLAIM_DEADLINE,
+    # disarmed before any compile can be in flight.
+    import claimguard
 
-    claim_done = threading.Event()
-    claim_deadline = float(os.environ.get("HARVEST_CLAIM_DEADLINE",
-                                          "3300"))
-
-    def _claim_watchdog():
-        if not claim_done.wait(claim_deadline):
-            emit(ev="abort",
-                 reason=f"backend claim past {claim_deadline:.0f}s; "
-                        "exiting before any compile starts")
-            os._exit(3)
-
-    threading.Thread(target=_claim_watchdog, daemon=True).start()
+    os.environ.setdefault("HARVEST_CLAIM_DEADLINE", "3300")
+    claim_disarm = claimguard.arm("harvest")
 
     import jax
     import jax.numpy as jnp
@@ -142,7 +128,7 @@ def main() -> None:
 
     # ---- backend confirm (the blocking tunnel claim happens here) ----
     plat = jax.devices()[0].platform
-    claim_done.set()  # disarm BEFORE any compile can be in flight
+    claim_disarm()  # BEFORE any compile can be in flight
     emit(ev="backend", platform=plat)
     if plat == "cpu" and not a.allow_cpu:
         emit(ev="abort", reason="cpu backend without --allow-cpu")

@@ -1,4 +1,12 @@
 #!/bin/bash
+# SUPERSEDED (round 4): scripts/harvest.py + scripts/watcher_r4.sh run
+# the whole ladder in one tunnel claim; this per-item queue is kept for
+# round-3 log provenance only. Known wart: `timeout --signal=CONT` is a
+# no-op bound (GNU timeout sends SIGCONT then keeps waiting), so the
+# 3600s value bounds nothing — deliberate here, since a measurement
+# child must never be killed, but it means one wedged item blocks the
+# queue; the round-4 harvester bounds only the pre-compile claim wait.
+#
 # TPU measurement recovery queue (round 3). Serialized: exactly one
 # axon claimant at a time (every python process with
 # PALLAS_AXON_POOL_IPS set claims a tunnel session at interpreter

@@ -4,13 +4,16 @@
 # harvester (scripts/harvest.py — the whole measurement ladder in one
 # claim) starts measuring. Never kills a client (round-2 lesson: a
 # killed axon client mid-compile can wedge the tunnel server); each
-# attempt is waited for to natural exit (harvest.py self-bounds its
-# backend-claim wait with a pre-compile watchdog). Deadline-capped so
-# the tunnel is clear before the driver's round-end bench.
+# attempt is waited for to natural exit, and every launched script
+# self-bounds its backend-claim wait via HARVEST_CLAIM_DEADLINE
+# (scripts/claimguard.py) so a wedged claim cannot outlive the
+# watcher's deadline. Deadline-capped so the tunnel is clear before
+# the driver's round-end bench.
 #
 # Phase gates require BOTH rc=0 and a chip-tagged log (round-3 ok()
 # discipline: partial logs from a crashed run must not count), recorded
-# as .ok marker files.
+# as .ok marker files. Logs are append-only: a retry must never
+# truncate a prior attempt's partial on-chip evidence.
 #
 # Usage: nohup bash scripts/watcher_r4.sh [deadline-hours] &
 set -u
@@ -28,14 +31,23 @@ if ! flock -n 9; then
   exit 1
 fi
 # wait out any still-running measurement claimants (round-3 queue
-# leftovers, or an orphaned harvest from a replaced watcher)
-while pgrep -f "run_queue.sh|queue_watcher|scripts/harvest.py" \
+# leftovers, or an orphaned child from a replaced watcher — any phase)
+while pgrep -f "run_queue.sh|queue_watcher|scripts/harvest.py|scripts/api_bench.py|[ /]bench.py" \
     > /dev/null 2>&1; do
   note "waiting for existing claimant processes to exit"
   sleep 60
 done
 
 deadline=$(( $(date +%s) + HOURS * 3600 ))
+# bound each attempt's backend-claim wait by the remaining watcher time
+# (floor 300s, cap 3300s)
+claim_remain() {
+  local r=$(( deadline - $(date +%s) ))
+  [ "$r" -lt 300 ] && r=300
+  [ "$r" -gt 3300 ] && r=3300
+  echo "$r"
+}
+
 note "armed; deadline in ${HOURS}h"
 i=0
 while [ "$(date +%s)" -lt "$deadline" ]; do
@@ -43,12 +55,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
   # Phase 1: the kernel ladder harvest (self-skips completed items)
   if [ ! -e measurements/harvest_tpu_r4.ok ]; then
     note "attempt $i: harvest"
-    # bound the backend-claim wait by the watcher's own remaining time
-    # (floor 300s) so an attempt started near the deadline cannot hold
-    # the tunnel claim into the driver's round-end bench
-    remain=$(( deadline - $(date +%s) )); [ "$remain" -lt 300 ] && remain=300
-    [ "$remain" -gt 3300 ] && remain=3300
-    HARVEST_CLAIM_DEADLINE=$remain \
+    HARVEST_CLAIM_DEADLINE=$(claim_remain) \
       python -u scripts/harvest.py >> measurements/harvest_tpu_r4.log \
       2>> measurements/harvest_tpu_r4.err
     rc=$?
@@ -60,23 +67,26 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
   # Phase 2: end-to-end API wave + FleetSession on the chip
   elif [ ! -e measurements/api_wave_tpu_r4.ok ]; then
     note "attempt $i: api_bench wave"
-    python -u scripts/api_bench.py --wave 1024 \
-      > measurements/api_wave_tpu_r4.log \
-      2> measurements/api_wave_tpu_r4.err
+    HARVEST_CLAIM_DEADLINE=$(claim_remain) \
+      python -u scripts/api_bench.py --wave 1024 \
+      >> measurements/api_wave_tpu_r4.log \
+      2>> measurements/api_wave_tpu_r4.err
     rc=$?
     note "attempt $i: api_bench rc=$rc"
-    if [ "$rc" = 0 ] && grep -qs '"platform": "tpu"' \
+    if [ "$rc" = 0 ] && grep -qs '"platform": "tpu' \
         measurements/api_wave_tpu_r4.log; then
       touch measurements/api_wave_tpu_r4.ok
     fi
-  # Phase 3: bookend bench.py (driver-format artifact, repetition)
+  # Phase 3: bookend bench.py (driver-format artifact, repetition).
+  # BENCH_TAG is cleared so the chip gate greps the real platform.
   elif [ ! -e measurements/bench_tpu_r4.ok ]; then
     note "attempt $i: bench.py bookend"
-    python bench.py > measurements/bench_tpu_r4.log \
-      2> measurements/bench_tpu_r4.err
+    env -u BENCH_TAG BENCH_PROBE_TIMEOUT=$(claim_remain) \
+      python bench.py >> measurements/bench_tpu_r4.log \
+      2>> measurements/bench_tpu_r4.err
     rc=$?
     note "attempt $i: bench rc=$rc"
-    if [ "$rc" = 0 ] && grep -qs '"platform": "tpu"' \
+    if [ "$rc" = 0 ] && grep -qs '"platform": "tpu' \
         measurements/bench_tpu_r4.log; then
       touch measurements/bench_tpu_r4.ok
     fi

@@ -31,11 +31,16 @@ def _run(extra_env):
     lines = [l for l in out.stdout.splitlines() if l.strip()]
     assert lines, out.stderr[-1500:]
     rec = json.loads(lines[-1])
-    for key in ("metric", "value", "unit", "vs_baseline", "platform",
-                "kernel", "config"):
+    for key in ("metric", "value", "unit", "vs_baseline", "vs_target",
+                "target_ms", "platform", "kernel", "config"):
         assert key in rec, (key, rec)
     assert rec["value"] and rec["value"] > 0
     assert rec["unit"] == "ms"
+    # vs_baseline is kept for driver compatibility; vs_target is the
+    # honest name (target-relative, no true baseline exists) — the two
+    # must always agree
+    assert rec["vs_target"] == rec["vs_baseline"]
+    assert rec["target_ms"] == 100.0
     return rec
 
 
