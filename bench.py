@@ -44,13 +44,21 @@ CPU_TIMEOUT_S = 900.0
 PROBE_TIMEOUT_S = float(os.environ.get("BENCH_PROBE_TIMEOUT", "180"))
 
 
-def _run_abandonable(cmd, env, deadline_s):
+def _run_abandonable(cmd, env, deadline_s, sentinel=None,
+                     sentinel_deadline_s=None):
     """Run a child; on deadline, ABANDON it (return None) instead of
     killing it. Round 2's hard lesson: a timeout-killed axon client
     mid-compile wedged the TPU tunnel server for hours — an abandoned
     client exits naturally when the backend errors, without poisoning
     the server for the next run. Output goes through temp files so the
-    abandoned child never blocks on a pipe."""
+    abandoned child never blocks on a pipe.
+
+    ``sentinel``: path the child touches once its backend is confirmed
+    (BENCH_SENTINEL protocol). When given, the effective deadline is
+    ``sentinel_deadline_s`` UNTIL the sentinel appears, then extends to
+    ``deadline_s`` — so one child serves as both the fast backend probe
+    and the full measurement, instead of paying two tunnel claims per
+    window (round-4 window-budget fix)."""
     import tempfile
 
     out_f = tempfile.NamedTemporaryFile("w+", delete=False, suffix=".out")
@@ -69,7 +77,15 @@ def _run_abandonable(cmd, env, deadline_s):
     for f in (out_f, err_f):
         os.unlink(f.name)
     t0 = time.monotonic()
-    while time.monotonic() - t0 < deadline_s:
+    probing = sentinel is not None
+    while True:
+        elapsed = time.monotonic() - t0
+        if probing and os.path.exists(sentinel):
+            probing = False
+        limit = (min(sentinel_deadline_s, deadline_s) if probing
+                 else deadline_s)
+        if elapsed >= limit:
+            break
         rc = p.poll()
         if rc is not None:
             out_f.seek(0)
@@ -79,31 +95,11 @@ def _run_abandonable(cmd, env, deadline_s):
             err_f.close()
             return got
         time.sleep(1.0)
-    print(f"bench: child past {deadline_s:.0f}s deadline; abandoning "
+    stage = "backend probe" if probing else "child"
+    print(f"bench: {stage} past {limit:.0f}s deadline; abandoning "
           "(not killing — a killed axon client can wedge the tunnel)",
           file=sys.stderr)
     return None
-
-
-def backend_alive() -> bool:
-    """Quick child-process probe of the default backend, so a wedged
-    TPU tunnel costs PROBE_TIMEOUT_S — not FULL_TIMEOUT_S — before the
-    bench falls back to CPU. A hung probe is abandoned, never killed."""
-    got = _run_abandonable(
-        [sys.executable, "-c", "import jax; jax.devices()"],
-        dict(os.environ), PROBE_TIMEOUT_S,
-    )
-    if got is None:
-        print("bench: backend probe wedged; skipping TPU attempt",
-              file=sys.stderr)
-        return False
-    rc, _out, err = got
-    if rc != 0:
-        tail = (err or "").strip().splitlines()[-1:] or ["?"]
-        print(f"bench: backend probe failed ({tail[0][:200]})",
-              file=sys.stderr)
-        return False
-    return True
 
 
 class _Overflow(RuntimeError):
@@ -148,6 +144,14 @@ def measure(platform: str) -> dict:
     )
 
     real_platform = jax.devices()[0].platform
+    # BENCH_SENTINEL protocol: tell the parent the backend answered, so
+    # it can extend this child's deadline from probe-scale to full-scale
+    # (one tunnel claim instead of a separate probe child + measure
+    # child per window)
+    sentinel = os.environ.get("BENCH_SENTINEL")
+    if sentinel:
+        with open(sentinel, "w") as f:
+            f.write(real_platform)
     # CPU runs full size too (the honest fallback evidence when the
     # tunnel is down); BENCH_SMOKE=1 forces the tiny shape
     smoke = _flag("BENCH_SMOKE")
@@ -235,8 +239,14 @@ def measure(platform: str) -> dict:
     p50_single = float(np.median(
         [_timed_once(step, k_max, kernel) for _ in range(reps)]
     ))
+    # Window budget: a burst costs N_BURST * p50_single. When the
+    # kernel is slow enough that the ~64-70 ms dispatch floor is noise
+    # (<7% at 1 s), amortized ~= single and repeated bursts buy nothing
+    # but tunnel time — one burst rep suffices. Near the target the
+    # floor matters and the full rep count is kept.
+    burst_reps = reps if p50_single < 1000.0 else 1
     p50_amortized = float(np.median(
-        [burst(k_max, kernel) for _ in range(reps)]
+        [burst(k_max, kernel) for _ in range(burst_reps)]
     ))
 
     # On real hardware, also try the fully-streaming configuration
@@ -271,11 +281,12 @@ def measure(platform: str) -> dict:
         jax.clear_caches()
         try:
             step(k_max, kernel)  # compile + overflow check
-            alt_amortized = float(np.median(
-                [burst(k_max, kernel) for _ in range(reps)]
-            ))
             alt_single = float(np.median(
                 [_timed_once(step, k_max, kernel) for _ in range(reps)]
+            ))
+            alt_burst_reps = reps if alt_single < 1000.0 else 1
+            alt_amortized = float(np.median(
+                [burst(k_max, kernel) for _ in range(alt_burst_reps)]
             ))
             # swap only now: every allstream measurement succeeded
             if alt_amortized < p50_amortized:
@@ -338,23 +349,24 @@ def main() -> None:
     # an explicitly requested CPU run is "cpu-forced"; "cpu-fallback"
     # only when a TPU attempt actually failed first. CPU falls back at
     # FULL size first (the honest ladder evidence), smoke size last.
+    # The TPU attempt probes and measures in ONE child: the parent
+    # bounds it at PROBE_TIMEOUT_S until the child's sentinel confirms
+    # the backend answered, then extends to FULL_TIMEOUT_S — a window
+    # pays one tunnel claim, not a probe claim plus a measure claim.
     if force_cpu:
         attempts = [("cpu", CPU_TIMEOUT_S, "cpu-forced", {}),
                     ("cpu", CPU_TIMEOUT_S, "cpu-forced",
                      {"BENCH_SMOKE": "1"})]
-    elif backend_alive():
+    else:
         attempts = [("default", FULL_TIMEOUT_S, "", {}),
                     ("cpu", CPU_TIMEOUT_S, "cpu-fallback", {}),
-                    ("cpu", CPU_TIMEOUT_S, "cpu-fallback",
-                     {"BENCH_SMOKE": "1"})]
-    else:
-        attempts = [("cpu", CPU_TIMEOUT_S, "cpu-fallback", {}),
                     ("cpu", CPU_TIMEOUT_S, "cpu-fallback",
                      {"BENCH_SMOKE": "1"})]
 
     errors = []
     for platform, timeout, tag, extra in attempts:
         env = dict(os.environ, BENCH_EXEC=platform, BENCH_TAG=tag, **extra)
+        sentinel = None
         if platform == "cpu":
             # a forced Pallas-walk kernel runs in interpret mode off-TPU
             # — sequential per row at full size, it would burn the whole
@@ -365,9 +377,40 @@ def main() -> None:
             for k in ("BENCH_KERNEL", "CAUSE_TPU_SORT",
                       "CAUSE_TPU_GATHER", "CAUSE_TPU_SEARCH"):
                 env.pop(k, None)
-        got = _run_abandonable([sys.executable, __file__], env, timeout)
+        else:
+            import glob
+            import tempfile
+
+            # recognizable prefix + stale sweep: an abandoned child may
+            # write its sentinel after the parent stopped looking, so
+            # old ones are cleaned on the next run instead of leaking
+            tdir = tempfile.gettempdir()
+            for old in glob.glob(os.path.join(tdir, "cause_bench_up_*")):
+                try:
+                    if time.time() - os.path.getmtime(old) > 3600:
+                        os.unlink(old)
+                except OSError:
+                    pass
+            sentinel = os.path.join(
+                tdir, f"cause_bench_up_{os.getpid()}_{int(time.time())}"
+            )
+            env["BENCH_SENTINEL"] = sentinel
+        got = _run_abandonable(
+            [sys.executable, __file__], env, timeout,
+            sentinel=sentinel,
+            sentinel_deadline_s=PROBE_TIMEOUT_S if sentinel else None,
+        )
+        if sentinel is not None and os.path.exists(sentinel):
+            os.unlink(sentinel)
         if got is None:
-            errors.append(f"{platform}: abandoned after {timeout:.0f}s")
+            # which deadline fired (probe vs full) is on stderr from
+            # _run_abandonable; record both bounds rather than claim
+            # the full timeout applied
+            errors.append(
+                f"{platform}: abandoned (probe {PROBE_TIMEOUT_S:.0f}s"
+                f"/full {timeout:.0f}s bounds)"
+                if sentinel is not None else
+                f"{platform}: abandoned after {timeout:.0f}s")
             print(f"bench: {platform} attempt abandoned; "
                   + ("retrying on CPU" if platform != "cpu" else
                      "trying next"), file=sys.stderr)

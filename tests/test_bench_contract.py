@@ -45,10 +45,28 @@ def _run(extra_env):
 
 
 def test_smoke_contract_cpu():
+    import time
+
+    t0 = time.monotonic()
     rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_SMOKE": "1"})
+    wall = time.monotonic() - t0
     assert rec["platform"] == "cpu-forced"
     assert "smoke size" in rec["metric"]
     # CPU/smoke runs must never claim the TPU-defined target
+    assert rec["vs_baseline"] == 0.0
+    # window-budget contract (round-3 verdict #4): probe -> JSON line
+    # must land fast — the smoke cold start bounds the fixed overhead
+    # (process + import + compile + harness) a tunnel window pays
+    assert wall < 120, f"bench.py smoke cold start took {wall:.0f}s"
+
+
+def test_single_claim_sentinel_path():
+    """The TPU attempt probes and measures in ONE child: on a CPU-only
+    box the 'default' attempt must still land (sentinel written after
+    backend confirm, deadline extended, honest platform tag) rather
+    than being abandoned at the probe deadline."""
+    rec = _run({"BENCH_SMOKE": "1"})
+    assert rec["platform"] == "cpu"
     assert rec["vs_baseline"] == 0.0
 
 
