@@ -113,9 +113,17 @@ def bitonic_sort(operands, num_keys: int = 1):
 
 
 def sort_pairs(operands, num_keys: int = 1):
-    """The kernels' sort: ``lax.sort`` by default, the bitonic network
-    when ``CAUSE_TPU_SORT=bitonic`` (trace-time switch for hardware
-    A/B)."""
-    if os.environ.get("CAUSE_TPU_SORT", "").strip() == "bitonic":
+    """The kernels' sort: ``lax.sort`` by default; trace-time switch
+    ``CAUSE_TPU_SORT`` selects ``bitonic`` (the XLA-level network —
+    elementwise stages, but each round-trips HBM) or ``pallas`` (the
+    same network VMEM-resident inside one Pallas kernel per 8-row
+    block — one HBM read + write per operand total) for hardware A/B
+    with no code change."""
+    mode = os.environ.get("CAUSE_TPU_SORT", "").strip()
+    if mode == "bitonic":
         return bitonic_sort(operands, num_keys=num_keys)
+    if mode == "pallas":
+        from .pallas_sort import pallas_bitonic_sort
+
+        return pallas_bitonic_sort(operands, num_keys=num_keys)
     return lax.sort(tuple(operands), num_keys=num_keys)

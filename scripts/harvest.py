@@ -351,24 +351,35 @@ def main() -> None:
     ALLSTREAM = {"CAUSE_TPU_SORT": "bitonic",
                  "CAUSE_TPU_GATHER": "rowgather",
                  "CAUSE_TPU_SEARCH": "matrix"}
+    # the round-4 headline candidate: VMEM-resident pallas sort +
+    # streaming gathers + matrix search + sequential euler walk
+    BESTSTREAM = {"CAUSE_TPU_SORT": "pallas",
+                  "CAUSE_TPU_GATHER": "rowgather",
+                  "CAUSE_TPU_SEARCH": "matrix"}
 
     # ---- the ladder, highest information value per second first -----
     # (1) headline, always re-measured; (2) phase attribution decides
-    # the round's direction; (3..) A/Bs; then fleet + v4 ladder point.
+    # the round's direction; (3) the best-guess combined config; then
+    # single-switch A/Bs to attribute whatever (3) shows; then fleet +
+    # v4 ladder point.
     ladder: list[tuple[str, object, tuple]] = [
         ("bench_v5", bench_item, ("bench_v5", "v5", {}, 8, False)),
         ("stages_default", stages_item, ("stages_default", {})),
-        ("bench_allstream", bench_item,
-         ("bench_allstream", "v5", ALLSTREAM)),
+        ("bench_beststream", bench_item,
+         ("bench_beststream", "v5w", BESTSTREAM)),
+        ("bench_psort", bench_item,
+         ("bench_psort", "v5", {"CAUSE_TPU_SORT": "pallas"})),
         ("bench_v5w", bench_item, ("bench_v5w", "v5w", {})),
-        ("bench_bitonic", bench_item,
-         ("bench_bitonic", "v5", {"CAUSE_TPU_SORT": "bitonic"})),
         ("bench_rowgather", bench_item,
          ("bench_rowgather", "v5", {"CAUSE_TPU_GATHER": "rowgather"})),
         ("bench_matrix", bench_item,
          ("bench_matrix", "v5", {"CAUSE_TPU_SEARCH": "matrix"})),
-        ("stages_allstream", stages_item,
-         ("stages_allstream", ALLSTREAM)),
+        ("bench_allstream", bench_item,
+         ("bench_allstream", "v5", ALLSTREAM)),
+        ("bench_bitonic", bench_item,
+         ("bench_bitonic", "v5", {"CAUSE_TPU_SORT": "bitonic"})),
+        ("stages_beststream", stages_item,
+         ("stages_beststream", BESTSTREAM)),
         ("fleet64", fleet_item, ("fleet64", 64, 2_000, 200, 2_560)),
         ("fleet256", fleet_item, ("fleet256", 256, 500, 64, 1_024)),
         ("bench_v4", bench_item, ("bench_v4", "v4", {})),

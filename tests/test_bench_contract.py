@@ -64,8 +64,15 @@ def test_single_claim_sentinel_path():
     """The TPU attempt probes and measures in ONE child: on a CPU-only
     box the 'default' attempt must still land (sentinel written after
     backend confirm, deadline extended, honest platform tag) rather
-    than being abandoned at the probe deadline."""
-    rec = _run({"BENCH_SMOKE": "1"})
+    than being abandoned at the probe deadline.
+
+    BENCH_PROBE_TIMEOUT=15 makes the test discriminating: the smoke
+    measurement takes well over 15s total, so if the sentinel did not
+    extend the deadline the attempt would be abandoned and fall back
+    to platform "cpu-fallback" — the assertion below would fail. (The
+    sentinel itself is written ~5s in, right after backend init;
+    generous margin over the 15s probe bound.)"""
+    rec = _run({"BENCH_SMOKE": "1", "BENCH_PROBE_TIMEOUT": "15"})
     assert rec["platform"] == "cpu"
     assert rec["vs_baseline"] == 0.0
 

@@ -183,6 +183,57 @@ def test_v5_all_switches_parity_tiny(monkeypatch):
         merge_weave_kernel_v5_jit.clear_cache()
 
 
+def test_v5_pallas_sort_parity_tiny(monkeypatch):
+    """CAUSE_TPU_SORT=pallas (the VMEM-resident in-kernel network)
+    must leave the v5 kernel's outputs bit-identical."""
+    from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5_jit
+
+    row = tiny_pair()
+    v5row = benchgen.v5_inputs(row, CAP)
+    u = benchgen.v5_token_budget(v5row)
+    args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+    base = merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+    monkeypatch.setenv("CAUSE_TPU_SORT", "pallas")
+    merge_weave_kernel_v5_jit.clear_cache()
+    try:
+        got = merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+        for b, g, name in zip(base, got,
+                              ("rank", "visible", "conflict",
+                               "overflow")):
+            assert np.array_equal(np.asarray(b), np.asarray(g)), name
+    finally:
+        monkeypatch.delenv("CAUSE_TPU_SORT")
+        merge_weave_kernel_v5_jit.clear_cache()
+
+
+def test_v5_pallas_allstream_parity_tiny(monkeypatch):
+    """rowgather + pallas-sort + matrix-search + walk combined must
+    stay bit-identical — the round-4 headline candidate config."""
+    from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5_jit
+
+    row = tiny_pair()
+    v5row = benchgen.v5_inputs(row, CAP)
+    u = benchgen.v5_token_budget(v5row)
+    args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+    base = merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+    monkeypatch.setenv("CAUSE_TPU_GATHER", "rowgather")
+    monkeypatch.setenv("CAUSE_TPU_SORT", "pallas")
+    monkeypatch.setenv("CAUSE_TPU_SEARCH", "matrix")
+    merge_weave_kernel_v5_jit.clear_cache()
+    try:
+        got = merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u,
+                                        euler="walk")
+        for b, g, name in zip(base, got,
+                              ("rank", "visible", "conflict",
+                               "overflow")):
+            assert np.array_equal(np.asarray(b), np.asarray(g)), name
+    finally:
+        for k in ("CAUSE_TPU_GATHER", "CAUSE_TPU_SORT",
+                  "CAUSE_TPU_SEARCH"):
+            monkeypatch.delenv(k)
+        merge_weave_kernel_v5_jit.clear_cache()
+
+
 def test_api_merge_parity_all_backends_extend_shape():
     """API-level pair merge on an extend-built (tx-run) tree: jax and
     native must match pure — tiny twin of the suites' big fuzz."""
