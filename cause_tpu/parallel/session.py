@@ -40,7 +40,8 @@ from ..collections import shared as s
 from ..weaver import lanecache
 from ..weaver.arrays import next_pow2
 from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
-from .wave import WaveBuffers, _PAD, _assemble_rows, _digest_fn
+from .wave import (WaveBuffers, _PAD, _assemble_rows, _digest_fn,
+                   _sampled_body_spotcheck)
 
 __all__ = ["FleetSession"]
 
@@ -145,6 +146,9 @@ class FleetSession:
         cap = next_pow2(max(max(va.n, vb.n) for va, vb in views))
         if cap < self.capacity:
             cap = self.capacity  # never shrink: resident shapes are fixed
+        # device-resident rounds never see host value bytes: sampled
+        # append-only body check on every (re-)upload (see wave.py)
+        _sampled_body_spotcheck(views)
         lanes = _assemble_rows(views, cap, bufs=self._bufs)
         from ..benchgen import v5_token_budget
 
@@ -228,6 +232,13 @@ class FleetSession:
         s_max = self.dev["sg_len"].shape[1]
         if s_needed > s_max:
             return self._full_upload(pairs)
+
+        # delta path committed from here on. The sampled append-only
+        # body check runs once per round: here on the delta path, or
+        # inside _full_upload when a branch above delegated to it (the
+        # corrupt lane may be resident from a previous upload, so the
+        # check always covers whole trees, not just deltas).
+        _sampled_body_spotcheck(views)
 
         for r, ((va, vb), _old) in enumerate(zip(views, self._views)):
             segs_a, segs_b = va.segments(), vb.segments()

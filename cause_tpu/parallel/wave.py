@@ -23,6 +23,9 @@ to the ordinary per-pair ``merge`` — same trees out, just slower.
 
 from __future__ import annotations
 
+import itertools
+import os
+import time
 from functools import lru_cache
 from typing import List, Optional, Sequence, Tuple
 
@@ -91,6 +94,68 @@ _PAD = {
     "hi": I32_MAX, "lo": I32_MAX, "cci": -1, "vc": 0, "valid": False,
     "seg": -1,
 }
+
+# Lanes sampled per tree per wave by the body spot-check below.
+# CAUSE_TPU_BODY_SAMPLE=0 disables; a value >= the tree size checks
+# every lane (what the adversarial tests use).
+_BODY_SAMPLE = int(os.environ.get("CAUSE_TPU_BODY_SAMPLE", "16") or 0)
+_wave_seq = itertools.count()
+
+
+def _sampled_body_spotcheck(views, k: Optional[int] = None) -> None:
+    """Close the device value-byte blind spot probabilistically.
+
+    The kernels dedupe twin segments by ids/classes/structure; host
+    VALUE bytes never reach the device (jaxw5 module caveat), so two
+    replicas sharing an id but differing in its body — an append-only
+    violation from a corrupt replica (reference rule:
+    shared.cljc:169-171) — would pass the device-only wave/digest
+    paths silently. ``WaveResult.merged`` validates fully, but fleets
+    that read only digests never call it.
+
+    This check samples ``k`` random lanes per tree per wave and
+    compares bodies with the twin via its O(1) ``lane_of`` index —
+    O(k) per pair instead of O(shared base), which is the entire point
+    of the segment-union design. Samples rotate each wave (counter
+    -seeded RNG), so repeated waves over a fleet accumulate coverage;
+    at the north-star scale one wave already draws ~16k samples.
+    """
+    k = _BODY_SAMPLE if k is None else k
+    if k <= 0:
+        return
+    # fresh entropy + a session counter: samples must differ both
+    # across waves in one process AND across process restarts, or the
+    # promised coverage accumulation never happens for one-wave-per
+    # -process deployments (CLI sync rounds)
+    rng = np.random.default_rng(
+        [os.getpid(), time.time_ns() & 0xFFFFFFFF, next(_wave_seq)]
+    )
+    for pair_idx, (va, vb) in enumerate(views):
+        for side, (src, dst) in enumerate(((va, vb), (vb, va))):
+            ns, nd = src.n, dst.n
+            if not ns or not nd:
+                continue
+            lanes = (range(ns) if k >= ns
+                     else rng.integers(0, ns, size=k))
+            sn, dn = src.arena.nodes, dst.arena.nodes
+            d_lane = dst.arena.lane_of
+            for ln in lanes:
+                nid, cause, value = sn[int(ln)]
+                j = d_lane.get(nid)
+                if (j is not None and j < nd
+                        and (dn[j][1] != cause or dn[j][2] != value)):
+                    # same convention as check_no_conflicting_bodies:
+                    # existing_node is the merge TARGET's body (dst);
+                    # plus enough context to quarantine the replica
+                    raise s.CausalError(
+                        "This node is already in the tree and can't "
+                        "be changed.",
+                        {"causes": {"append-only", "edits-not-allowed"},
+                         "existing_node": (nid,) + tuple(dn[j][1:]),
+                         "conflicting_node": (nid, cause, value),
+                         "pair": pair_idx,
+                         "conflicting_side": "a" if side == 0 else "b"},
+                    )
 
 
 def _assemble_rows(views: Sequence[Tuple["lanecache.LaneView",
@@ -269,6 +334,8 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
         max(va.n, vb.n) for i in live for va, vb in [views[i]]
     ))
     live_views = [views[i] for i in live]
+    # device paths never see host value bytes; sampled host-side check
+    _sampled_body_spotcheck(live_views)
     if mesh is not None and len(live_views) % mesh.size:
         # fallbacks shrank the batch below mesh divisibility: pad with
         # copies of the first live row and drop their outputs below

@@ -135,9 +135,15 @@ def test_wave_mesh_survives_fallback_shrink():
         assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(x.merge(y))
 
 
-def test_wave_merged_validates_conflicting_bodies():
+def test_wave_merged_validates_conflicting_bodies(monkeypatch):
     """merged() must raise on conflicting duplicate ids exactly like
-    a.merge(b) — never return a weave/nodes-inconsistent tree."""
+    a.merge(b) — never return a weave/nodes-inconsistent tree. The
+    wave-time sampled spot-check is disabled here so the test pins the
+    merged()-level validation specifically (with default sampling the
+    wave itself usually raises first — see the spotcheck tests)."""
+    from cause_tpu.parallel import wave as wave_mod
+
+    monkeypatch.setattr(wave_mod, "_BODY_SAMPLE", 0)
     pairs = make_pairs(1, n_base=20, n_div=2)
     a, b = pairs[0]
     evil_id = (500, a.get_site_id(), 0)
@@ -216,5 +222,78 @@ def test_wave_overflow_rows_retry_on_device():
     res = merge_wave(pairs)
     assert not res.fallback
     assert res.digest_valid.all()
+    for i, (x, y) in enumerate(pairs):
+        assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(x.merge(y))
+
+
+def _corrupt_pair(n_base=80, n_div=6):
+    """A replica pair where B's copy of one shared-base node differs
+    ONLY in its string payload (same id, same value class) — the
+    append-only violation the device kernels cannot see (jaxw5 module
+    caveat: host value bytes never reach the device)."""
+    from cause_tpu.collections import clist as clmod
+    from cause_tpu.collections.shared import refresh_caches
+
+    base = c.clist(weaver="jax").extend([f"w{i}" for i in range(n_base)])
+    a = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+        [f"a{i}" for i in range(n_div)]
+    )
+    victim = list(base)[n_base // 2][0]
+    nodes2 = dict(base.ct.nodes)
+    cause, _val = nodes2[victim]
+    nodes2[victim] = (cause, "CORRUPT")
+    b_ct = refresh_caches(
+        clmod.weave,
+        base.ct.evolve(nodes=nodes2, yarns={}, site_id=new_site_id()),
+    )
+    b = CausalList(b_ct).extend([f"b{i}" for i in range(n_div)])
+    return a, b
+
+
+def test_value_byte_corruption_trips_wave_spotcheck(monkeypatch):
+    """VERDICT r3 Weak #4: the device-only wave path must detect twins
+    differing only in one string payload. Full-coverage sampling makes
+    the probabilistic check deterministic for the test."""
+    from cause_tpu.parallel import wave as wave_mod
+
+    monkeypatch.setattr(wave_mod, "_BODY_SAMPLE", 10**9)
+    a, b = _corrupt_pair()
+    with pytest.raises(c.CausalError) as ei:
+        merge_wave([(a, b)])
+    assert "append-only" in ei.value.info["causes"]
+
+
+def test_value_byte_corruption_trips_session_spotcheck(monkeypatch):
+    from cause_tpu.parallel import wave as wave_mod
+    from cause_tpu.parallel.session import FleetSession
+
+    monkeypatch.setattr(wave_mod, "_BODY_SAMPLE", 10**9)
+    a, b = _corrupt_pair()
+    with pytest.raises(c.CausalError) as ei:
+        FleetSession([(a, b)])
+    assert "append-only" in ei.value.info["causes"]
+
+
+def test_spotcheck_disabled_documents_blind_spot(monkeypatch):
+    """With sampling off the wave completes (the historical device
+    -only behavior) — and materializing the pair still raises via the
+    full host validation, which is the API-path guarantee."""
+    from cause_tpu.parallel import wave as wave_mod
+
+    monkeypatch.setattr(wave_mod, "_BODY_SAMPLE", 0)
+    a, b = _corrupt_pair()
+    res = merge_wave([(a, b)])
+    assert not res.fallback
+    with pytest.raises(c.CausalError):
+        res.merged(0)
+
+
+def test_spotcheck_clean_pairs_pass(monkeypatch):
+    from cause_tpu.parallel import wave as wave_mod
+
+    monkeypatch.setattr(wave_mod, "_BODY_SAMPLE", 10**9)
+    pairs = make_pairs(3)
+    res = merge_wave(pairs)
+    assert not res.fallback
     for i, (x, y) in enumerate(pairs):
         assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(x.merge(y))
