@@ -66,10 +66,19 @@ def take1d(table, idx):
 
 def searchsorted_iota_right(keys_cum, q: int):
     """``searchsorted(keys_cum, arange(q), side="right")`` for a
-    NON-DECREASING ``keys_cum`` — streaming form: histogram the keys
-    and prefix-sum, no per-query binary search. Always used (it is
-    strictly elementwise + one scatter-add + one cumsum; there is
-    nothing to A/B)."""
+    NON-DECREASING ``keys_cum``.
+
+    Default: histogram the keys (one scatter-add) and prefix-sum — no
+    per-query binary search. But an XLA TPU scatter is random access
+    just like a gather, so ``CAUSE_TPU_SEARCH=matrix`` (trace-time)
+    switches to the O(n*q) comparison-matrix count — side="right"
+    index = #{keys <= target} — which is pure elementwise work the VPU
+    streams with zero random access (same trade as
+    jaxw5._pair_search_le)."""
+    if os.environ.get("CAUSE_TPU_SEARCH", "").strip() == "matrix":
+        tgt = jnp.arange(q, dtype=keys_cum.dtype)
+        le = keys_cum[None, :] <= tgt[:, None]
+        return jnp.sum(le, axis=1).astype(jnp.int32)
     hist = jnp.zeros(q + 1, jnp.int32).at[
         jnp.clip(keys_cum, 0, q)
     ].add(1, mode="drop")
