@@ -282,7 +282,8 @@ def measure(platform: str) -> dict:
     # for the watcher's isolated A/B runs.
     preset = [f"{k.split('_')[-1].lower()}={os.environ[k]}"
               for k in ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER",
-                        "CAUSE_TPU_SEARCH") if os.environ.get(k)]
+                        "CAUSE_TPU_SEARCH", "CAUSE_TPU_SCATTER")
+              if os.environ.get(k)]
     config = "+".join(preset) if preset else "default"
     # start gate only — a pathological allstream compile after it can
     # still hit the parent deadline, so the gate is conservative (the
@@ -300,7 +301,8 @@ def measure(platform: str) -> dict:
         # XLA-level network round-trips every stage through HBM)
         os.environ["CAUSE_TPU_SORT"] = "pallas"
         os.environ["CAUSE_TPU_GATHER"] = "rowgather"
-        os.environ["CAUSE_TPU_SEARCH"] = "matrix"
+        os.environ["CAUSE_TPU_SEARCH"] = "matrix-table"
+        os.environ["CAUSE_TPU_SCATTER"] = "hint"
         # the switches are read at TRACE time inside module-level
         # jitted kernels whose caches key on avals only — without a
         # cache clear the "allstream" attempt would silently re-trace
@@ -330,7 +332,7 @@ def measure(platform: str) -> dict:
                   "keeping default", file=sys.stderr)
         finally:
             for k in ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER",
-                      "CAUSE_TPU_SEARCH"):
+                      "CAUSE_TPU_SEARCH", "CAUSE_TPU_SCATTER"):
                 os.environ.pop(k, None)
             jax.clear_caches()  # stale switch-traced programs
 
@@ -403,7 +405,8 @@ def main() -> None:
             # are pessimizations on CPU. The CPU evidence always uses
             # the default ladder and default strategies.
             for k in ("BENCH_KERNEL", "CAUSE_TPU_SORT",
-                      "CAUSE_TPU_GATHER", "CAUSE_TPU_SEARCH"):
+                      "CAUSE_TPU_GATHER", "CAUSE_TPU_SEARCH",
+                      "CAUSE_TPU_SCATTER"):
                 env.pop(k, None)
         else:
             import glob

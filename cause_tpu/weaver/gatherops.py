@@ -74,7 +74,13 @@ def searchsorted_iota_right(keys_cum, q: int):
     switches to the O(n*q) comparison-matrix count — side="right"
     index = #{keys <= target} — which is pure elementwise work the VPU
     streams with zero random access (same trade as
-    jaxw5._pair_search_le)."""
+    jaxw5._pair_search_le). NOTE: at token width the matrix is
+    [q, n] ~ 5M/row; if XLA materializes it instead of fusing the
+    reduction this form loses badly (47 s/op on CPU!), so the
+    narrower ``matrix-table`` value applies matrix search only to the
+    S-width table search in jaxw5 and leaves this histogram alone —
+    that is what the combined beststream config uses until the
+    microbench decides."""
     if os.environ.get("CAUSE_TPU_SEARCH", "").strip() == "matrix":
         tgt = jnp.arange(q, dtype=keys_cum.dtype)
         le = keys_cum[None, :] <= tgt[:, None]

@@ -76,6 +76,25 @@ def _stage_ck(*xs):
     return total
 
 
+def _hint_kw(sorted_: bool = False, unique: bool = False) -> dict:
+    """Scatter-annotation kwargs under ``CAUSE_TPU_SCATTER=hint``
+    (trace-time A/B switch): an XLA TPU scatter serializes to handle
+    potential duplicate indices; the kernel's scatter sites below are
+    rewritten so their index streams are unique (and mostly sorted) BY
+    CONSTRUCTION — invalid entries dump into per-position spread slots
+    past the live range instead of sharing one dump index — so the
+    annotations are provable, not merely test-passing. Off by default
+    so the hardware A/B isolates their effect."""
+    if os.environ.get("CAUSE_TPU_SCATTER", "").strip() != "hint":
+        return {}
+    kw = {}
+    if sorted_:
+        kw["indices_are_sorted"] = True
+    if unique:
+        kw["unique_indices"] = True
+    return kw
+
+
 def _lt(a1, a2, b1, b2):
     return (a1 < b1) | ((a1 == b1) & (a2 < b2))
 
@@ -105,12 +124,16 @@ def _pair_search_le(kh, kl, qh, ql, size):
     arrays with key[i] <= query (-1 if none).
 
     Default: a fori binary search (log2(size) rounds of table
-    gathers). ``CAUSE_TPU_SEARCH=matrix`` (trace-time) counts
-    key<=query over the full [q, size] comparison matrix instead —
-    O(size^2) elementwise work that streams on the VPU with zero
-    random access; at the segment-table widths (size ~512) that is
-    cheaper on TPU than 10 gather rounds."""
-    if os.environ.get("CAUSE_TPU_SEARCH", "").strip() == "matrix":
+    gathers). ``CAUSE_TPU_SEARCH=matrix`` or ``matrix-table``
+    (trace-time) counts key<=query over the full [q, size] comparison
+    matrix instead — O(size^2) elementwise work that streams on the
+    VPU with zero random access; at the segment-table widths
+    (size ~512) that is cheaper on TPU than 10 gather rounds.
+    (``matrix-table`` applies matrix search HERE only, leaving the
+    U-width searchsorted histogram in gatherops untouched — see its
+    docstring for why.)"""
+    if os.environ.get("CAUSE_TPU_SEARCH", "").strip() in (
+            "matrix", "matrix-table"):
         le = _le(kh[None, :], kl[None, :], qh[:, None], ql[:, None])
         return jnp.sum(le, axis=1).astype(jnp.int32) - 1
 
@@ -205,16 +228,23 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     grp_start = ~same_prev
     grp = jnp.cumsum(grp_start.astype(jnp.int32)) - 1
 
-    # per-group interval tables (twins share min/max by construction)
-    gsl = jnp.where(grp_start & s_va, grp, S - 1)
-    g_mh = jnp.full(S, BIG, jnp.int32).at[gsl].set(
-        jnp.where(grp_start & s_va, s_mh, BIG), mode="drop")
-    g_ml = jnp.full(S, BIG, jnp.int32).at[gsl].set(
-        jnp.where(grp_start & s_va, s_ml, BIG), mode="drop")
-    g_Mh = jnp.full(S, -1, jnp.int32).at[gsl].set(
-        jnp.where(grp_start & s_va, s_Mh, -1), mode="drop")
-    g_Ml = jnp.full(S, -1, jnp.int32).at[gsl].set(
-        jnp.where(grp_start & s_va, s_Ml, -1), mode="drop")
+    # per-group interval tables (twins share min/max by construction).
+    # Scatter indices: group ordinals at group starts (strictly
+    # increasing), everything else dumped into its own spread slot past
+    # S — unique by construction, so the scatter needs no duplicate
+    # handling (annotated under CAUSE_TPU_SCATTER=hint).
+    is_start = grp_start & s_va
+    gsl = jnp.where(is_start, grp, S + sidx)
+    uniq = _hint_kw(unique=True)
+
+    def _gtable(vals, fill):
+        return jnp.full(2 * S, fill, jnp.int32).at[gsl].set(
+            jnp.where(is_start, vals, fill), **uniq)[:S]
+
+    g_mh = _gtable(s_mh, BIG)
+    g_ml = _gtable(s_ml, BIG)
+    g_Mh = _gtable(s_Mh, -1)
+    g_Ml = _gtable(s_Ml, -1)
 
     # E1: overlap with any earlier group (prefix pair-max of maxes,
     # exclusive) or the next group (its min is the smallest later min)
@@ -232,8 +262,8 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     pgc = jnp.clip(pg, 0, S - 1)
     # group tables for the stabbed group: len/tail-specialness of its
     # representative member (first of group; twins agree)
-    rep = jnp.full(S, 0, jnp.int32).at[gsl].set(
-        jnp.where(grp_start & s_va, sidx, 0), mode="drop")
+    rep = jnp.full(2 * S, 0, jnp.int32).at[gsl].set(
+        jnp.where(is_start, sidx, 0), **uniq)[:S]
     rep_pg = take1d(rep, pgc)
     r_len = take1d(s_len, rep_pg)
     r_tsp = take1d(s_tsp, rep_pg)
@@ -288,7 +318,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # a twin-DROPPED segment copy (tree B's own copy of the shared
     # base) must resolve to the KEPT twin's token: group-start fill
     # gsp redirects any twin member to its group's first (kept) member.
-    inv_s = jnp.zeros(S, jnp.int32).at[s_src].set(sidx)
+    inv_s = jnp.zeros(S, jnp.int32).at[s_src].set(sidx, **uniq)
     seg_expl_sorted = s_va & explode
     gsp = lax.cummax(jnp.where(grp_start, sidx, -1))
 
@@ -302,13 +332,30 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
                 + jnp.where(ex, pc - take1d(sg_lane0, m), 0)).astype(jnp.int32)
 
     # ================= C. sort tokens, dedupe =======================
+    # With a network sort (bitonic/pallas) the payload fields RIDE the
+    # sort — one roll+select per stage each, all streaming — instead of
+    # five post-sort permutation gathers (the expensive primitive the
+    # strategy exists to avoid). With the default comparator sort,
+    # extra variadic operands slow the comparator, so the gather form
+    # stays. Identical results either way: same keys, same implicit
+    # -iota stability, and payload-carry == gather-by-permutation.
     su_src_in = uidx
-    st_hi, st_lo, t_src = sort_pairs((t_hi, t_lo, su_src_in),
-                                     num_keys=2)
-    inv_t = jnp.zeros(U, jnp.int32).at[t_src].set(uidx)
-    g = lambda arr: take1d(arr, t_src)  # presort field -> sorted order
-    sv_len, sv_vc, sv_tsp = g(t_len), g(t_vc), g(t_tsp)
-    sv_lane, sv_tail_lane = g(t_lane), g(t_tail_lane)
+    ride = os.environ.get("CAUSE_TPU_SORT", "").strip() in (
+        "bitonic", "pallas")
+    if ride:
+        (st_hi, st_lo, t_src, sv_len, sv_vc, sv_tsp_i,
+         sv_lane) = sort_pairs(
+            (t_hi, t_lo, su_src_in, t_len, t_vc,
+             t_tsp.astype(jnp.int32), t_lane), num_keys=2)
+        sv_tsp = sv_tsp_i.astype(bool)
+        sv_tail_lane = sv_lane + sv_len - 1  # == permuted t_tail_lane
+    else:
+        st_hi, st_lo, t_src = sort_pairs((t_hi, t_lo, su_src_in),
+                                         num_keys=2)
+        g = lambda arr: take1d(arr, t_src)  # presort -> sorted order
+        sv_len, sv_vc, sv_tsp = g(t_len), g(t_vc), g(t_tsp)
+        sv_lane, sv_tail_lane = g(t_lane), g(t_tail_lane)
+    inv_t = jnp.zeros(U, jnp.int32).at[t_src].set(uidx, **uniq)
 
     tva = ~((st_hi == BIG) & (st_lo == BIG))
     sdup = (
@@ -447,11 +494,14 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
                                     base_run[:-1]]),
         0,
     )
-    delta_u = jnp.zeros(U, jnp.int32).at[
-        jnp.where(r_valid, hc, U - 1)
-    ].set(delta.astype(jnp.int32), mode="drop")
-    last_fix = jnp.sum(jnp.where(r_valid & (hc == U - 1), delta, 0))
-    delta_u = delta_u.at[U - 1].set(last_fix.astype(jnp.int32))
+    # valid targets are a prefix with strictly increasing head tokens;
+    # invalid ones dump into spread slots past U — the index stream is
+    # globally sorted AND unique by construction (no shared dump slot,
+    # no collision fix-up needed)
+    scat_du = jnp.where(r_valid, hc, U + kidx_r)
+    delta_u = jnp.zeros(U + k_max, jnp.int32).at[scat_du].set(
+        delta.astype(jnp.int32),
+        **_hint_kw(sorted_=True, unique=True))[:U]
     base_ff = jnp.cumsum(delta_u)
     ffw = lax.cummax(jnp.where(run_start, wstart, -1))
     rank_tok = jnp.where(
@@ -477,7 +527,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
         b_sorted[1:] != BIG, jnp.zeros((1,), bool)
     ])
     succ_of = jnp.full(k_max, -1, jnp.int32).at[b_src].set(
-        jnp.where(succ_valid, succ_in_sorted, -1)
+        jnp.where(succ_valid, succ_in_sorted, -1), **uniq
     )
     succ_run = jnp.where(r_valid, succ_of, -1)
     s_c = jnp.clip(
@@ -509,8 +559,12 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # deltas scatter + cumsum reconstructs per-lane values without any
     # full-width gather
     lane_key = jnp.where(keep_t & (rank_tok < N), sv_lane, N)
-    lk, tok_at = sort_pairs((lane_key, uidx), num_keys=1)
-    tb_l = take1d(rank_tok, tok_at)
+    if ride:  # rank rides the lane sort (see phase C note)
+        lk, tok_at, tb_l = sort_pairs((lane_key, uidx, rank_tok),
+                                      num_keys=1)
+    else:
+        lk, tok_at = sort_pairs((lane_key, uidx), num_keys=1)
+        tb_l = take1d(rank_tok, tok_at)
     tl_l = jnp.where(lk < N, lk, 0)
     ok_l = lk < N
     d_base = jnp.where(
@@ -523,7 +577,11 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
         tl_l - jnp.concatenate([jnp.zeros((1,), jnp.int32), tl_l[:-1]]),
         0,
     )
-    scat = jnp.where(ok_l, tl_l, N)
+    # kept tokens sit in the sorted prefix with strictly increasing
+    # lanes; the rest dump into spread slots past N — sorted + unique
+    # by construction (annotated under CAUSE_TPU_SCATTER=hint)
+    scat = jnp.where(ok_l, tl_l, N + uidx)
+    su_kw = _hint_kw(sorted_=True, unique=True)
     bits = (N - 1).bit_length()
     if 2 * bits <= 30:
         # base and lane are both < N, so their delta streams pack into
@@ -531,33 +589,35 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
         # of two of each (deltas may be negative, but the cumsum is
         # exact and every prefix total is a valid packed (base, lane))
         d_pack = d_base * (1 << bits) + d_lane
-        pack_n = jnp.zeros(N, jnp.int32).at[scat].add(d_pack,
-                                                      mode="drop")
+        pack_n = jnp.zeros(N + U, jnp.int32).at[scat].add(
+            d_pack, **su_kw)[:N]
         pack_fill = jnp.cumsum(pack_n)
         base_fill = pack_fill >> bits
         lane_fill = pack_fill & ((1 << bits) - 1)
     else:  # concat width N > 32k (per-tree capacity > 16k): packed
            # pairs would overflow int32
-        base_n = jnp.zeros(N, jnp.int32).at[scat].add(d_base,
-                                                      mode="drop")
-        lane_n = jnp.zeros(N, jnp.int32).at[scat].add(d_lane,
-                                                      mode="drop")
+        base_n = jnp.zeros(N + U, jnp.int32).at[scat].add(
+            d_base, **su_kw)[:N]
+        lane_n = jnp.zeros(N + U, jnp.int32).at[scat].add(
+            d_lane, **su_kw)[:N]
         base_fill = jnp.cumsum(base_n)
         lane_fill = jnp.cumsum(lane_n)
-    has_tok = jnp.zeros(N, bool).at[scat].set(True, mode="drop")
+    has_tok = jnp.zeros(N + U, bool).at[scat].set(True, **su_kw)[:N]
     lane_idx = jnp.arange(N, dtype=jnp.int32)
 
     # per-lane coverage flags from the segment tables (marshal order =
     # ascending lane order): covered = lane belongs to a token that is
     # kept, either via its own token (exploded) or its segment's token
-    cov_cnt = jnp.zeros(N + 1, jnp.int32)
     seg_cov = sg_valid & take1d(survive, inv_s)
+    # spread dump slots past N keep both index streams unique (segment
+    # starts/ends are distinct for live segments: disjoint ascending)
+    cov_cnt = jnp.zeros(N + 1 + S, jnp.int32)
     cov_cnt = cov_cnt.at[
-        jnp.where(seg_cov, sg_lane0, N)
-    ].add(1, mode="drop")
+        jnp.where(seg_cov, sg_lane0, N + 1 + sidx)
+    ].add(1, **uniq)
     cov_cnt = cov_cnt.at[
-        jnp.where(seg_cov, sg_lane0 + sg_len, N)
-    ].add(-1, mode="drop")
+        jnp.where(seg_cov, sg_lane0 + sg_len, N + 1 + sidx)
+    ].add(-1, **uniq)
     in_surviving = jnp.cumsum(cov_cnt[:N]) > 0
 
     # surviving-segment lanes take the seg token's base + offset (their

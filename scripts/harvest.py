@@ -18,7 +18,8 @@ Design rules (from rounds 2-3):
 - ``jax.block_until_ready`` does not block on the tunnel: every timed
   program reduces to a scalar and the harness forces the 4-byte
   device->host fetch (the only reliable sync).
-- Trace-time kernel switches (CAUSE_TPU_SORT/GATHER/SEARCH) require
+- Trace-time kernel switches (CAUSE_TPU_SORT/GATHER/SEARCH/SCATTER)
+  require
   ``jax.clear_caches()`` between configs or the A/B silently re-times
   the cached default program.
 
@@ -48,7 +49,8 @@ STATE_PATH = os.path.join(
     "measurements", "harvest_state_r4.json",
 )
 
-SWITCHES = ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER", "CAUSE_TPU_SEARCH")
+SWITCHES = ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER",
+            "CAUSE_TPU_SEARCH", "CAUSE_TPU_SCATTER")
 
 
 def emit(**obj):
@@ -294,6 +296,30 @@ def main() -> None:
         finally:
             set_config({})
 
+    def micro_item(name):
+        """Primitive-strategy A/Bs at exact kernel shapes (shares this
+        process's tunnel claim; scripts/tpu_microbench.py cases)."""
+        if a.smoke:
+            emit(ev="skip", item=name,
+                 reason="microbench cases are full-size only")
+            return
+        import tpu_microbench as mb
+
+        ok = True
+        for case in mb.TOK_CASES:
+            try:
+                per_op, once = mb.ALL[case]()
+                emit(ev="micro", item=name, case=case,
+                     per_op_ms=round(per_op, 2),
+                     single_dispatch_ms=round(once, 1), platform=plat)
+            except Exception as e:  # noqa: BLE001 - keep measuring
+                ok = False
+                emit(ev="error", item=name, case=case,
+                     error=f"{type(e).__name__}: {str(e)[:200]}")
+        if ok and record_state:
+            done.add(name)
+            save_state(done)
+
     def fleet_item(name, K, nb, nd, cap):
         from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5_jit
 
@@ -355,7 +381,8 @@ def main() -> None:
     # streaming gathers + matrix search + sequential euler walk
     BESTSTREAM = {"CAUSE_TPU_SORT": "pallas",
                   "CAUSE_TPU_GATHER": "rowgather",
-                  "CAUSE_TPU_SEARCH": "matrix"}
+                  "CAUSE_TPU_SEARCH": "matrix-table",
+                  "CAUSE_TPU_SCATTER": "hint"}
 
     # ---- the ladder, highest information value per second first -----
     # (1) headline, always re-measured; (2) phase attribution decides
@@ -374,12 +401,15 @@ def main() -> None:
          ("bench_rowgather", "v5", {"CAUSE_TPU_GATHER": "rowgather"})),
         ("bench_matrix", bench_item,
          ("bench_matrix", "v5", {"CAUSE_TPU_SEARCH": "matrix"})),
+        ("bench_schint", bench_item,
+         ("bench_schint", "v5", {"CAUSE_TPU_SCATTER": "hint"})),
         ("bench_allstream", bench_item,
          ("bench_allstream", "v5", ALLSTREAM)),
         ("bench_bitonic", bench_item,
          ("bench_bitonic", "v5", {"CAUSE_TPU_SORT": "bitonic"})),
         ("stages_beststream", stages_item,
          ("stages_beststream", BESTSTREAM)),
+        ("microbench", micro_item, ("microbench",)),
         ("fleet64", fleet_item, ("fleet64", 64, 2_000, 200, 2_560)),
         ("fleet256", fleet_item, ("fleet256", 256, 500, 64, 1_024)),
         ("bench_v4", bench_item, ("bench_v4", "v4", {})),

@@ -183,6 +183,94 @@ def bench_tokrowgather():
     return _slope(lambda t, i: (t, rowgather1d(t, i)), (tab, idx))
 
 
+def bench_tokpallas():
+    """pallas_bitonic_sort at the token shape — the round-4
+    CAUSE_TPU_SORT=pallas candidate (VMEM-resident network)."""
+    from cause_tpu.weaver.pallas_sort import pallas_bitonic_sort
+
+    hi, lo, src = _tok_data()
+    return _slope(
+        lambda a, b, s: pallas_bitonic_sort((a, b, s), num_keys=2),
+        (hi, lo, src),
+    )
+
+
+def _scat_data():
+    """Sorted-unique scatter targets: U=2252 distinct ascending lanes
+    per row out of N=20480 — the index-stream shape the kernels'
+    spread-dump rewrites guarantee."""
+    rng = np.random.default_rng(3)
+    tab = jnp.asarray(rng.integers(0, 1 << 20, (1024, 20480),
+                                   dtype=np.int32))
+    idx = jnp.asarray(np.sort(
+        np.argsort(rng.random((1024, 20480)), axis=1)[:, :2252], axis=1
+    ).astype(np.int32))
+    val = jnp.asarray(rng.integers(-64, 64, (1024, 2252),
+                                   dtype=np.int32))
+    return tab, idx, val
+
+
+def bench_tokscatter():
+    """Plain XLA scatter-add, U values into N slots, 1024 rows."""
+    tab, idx, val = _scat_data()
+
+    def f(t, i, v):
+        out = jax.vmap(lambda o, ii, vv: o.at[ii].add(vv))(t, i, v)
+        return out, i, v
+
+    return _slope(f, (tab, idx, val))
+
+
+def bench_tokscatterhint():
+    """The same scatter with unique_indices + indices_are_sorted —
+    the CAUSE_TPU_SCATTER=hint candidate."""
+    tab, idx, val = _scat_data()
+
+    def f(t, i, v):
+        out = jax.vmap(
+            lambda o, ii, vv: o.at[ii].add(
+                vv, unique_indices=True, indices_are_sorted=True)
+        )(t, i, v)
+        return out, i, v
+
+    return _slope(f, (tab, idx, val))
+
+
+def _search_bench(mode):
+    import os
+
+    from cause_tpu.weaver import gatherops
+
+    rng = np.random.default_rng(4)
+    kc = jnp.asarray(np.cumsum(
+        rng.integers(0, 3, (1024, 2252)), axis=1).astype(np.int32))
+    if mode:
+        os.environ["CAUSE_TPU_SEARCH"] = mode
+    else:
+        os.environ.pop("CAUSE_TPU_SEARCH", None)
+    try:
+        def f(k):
+            out = jax.vmap(
+                lambda kk: gatherops.searchsorted_iota_right(kk, 2252)
+            )(k)
+            return (out,)
+
+        return _slope(f, (kc,))
+    finally:
+        os.environ.pop("CAUSE_TPU_SEARCH", None)
+
+
+def bench_searchhist():
+    """searchsorted histogram form (scatter-add + cumsum) at U."""
+    return _search_bench("")
+
+
+def bench_searchmatrix():
+    """searchsorted comparison-matrix form at U — the
+    CAUSE_TPU_SEARCH=matrix candidate."""
+    return _search_bench("matrix")
+
+
 ALL = {
     "elementwise": bench_elementwise,
     "cumsum": bench_cumsum,
@@ -193,9 +281,19 @@ ALL = {
     "scatter": bench_scatter,
     "toksort": bench_toksort,
     "tokbitonic": bench_tokbitonic,
+    "tokpallas": bench_tokpallas,
     "tokgather": bench_tokgather,
     "tokrowgather": bench_tokrowgather,
+    "tokscatter": bench_tokscatter,
+    "tokscatterhint": bench_tokscatterhint,
+    "searchhist": bench_searchhist,
+    "searchmatrix": bench_searchmatrix,
 }
+
+# the decision-driving subset the round-4 harvester runs in-claim
+TOK_CASES = ("toksort", "tokbitonic", "tokpallas", "tokgather",
+             "tokrowgather", "tokscatter", "tokscatterhint",
+             "searchhist", "searchmatrix", "cumsum", "elementwise")
 
 
 def main():

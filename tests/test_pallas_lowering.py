@@ -152,3 +152,61 @@ def test_walk_parity_vs_doubling_after_redesign():
     )(*batch)
     for r in range(B):
         assert np.array_equal(np.asarray(want), np.asarray(got_b[r]))
+
+
+def test_v5_scatter_hint_exports_for_tpu(monkeypatch):
+    """The annotated-scatter configuration must lower for TPU."""
+    monkeypatch.setenv("CAUSE_TPU_SCATTER", "hint")
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=120, n_div=40, capacity=256, hide_every=8
+    )
+    v5 = benchgen.batched_v5_inputs(batch, 256)
+    u = benchgen.v5_token_budget(v5)
+    args = [jnp.asarray(v5[k]) for k in LANE_KEYS5]
+
+    def f(*a):
+        return batched_merge_weave_v5(*a, u_max=u, k_max=u)
+
+    batched_merge_weave_v5.clear_cache()
+    try:
+        jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+    finally:
+        batched_merge_weave_v5.clear_cache()
+
+
+def test_v5_beststream_combined_exports_for_tpu(monkeypatch):
+    """The exact shipped beststream combination (pallas sort +
+    rowgather + matrix-table + scatter hints + euler walk) must lower
+    for TPU — the program a window's alt attempt compiles."""
+    from cause_tpu.weaver import pallas_ops, pallas_sort
+
+    monkeypatch.setattr(pallas_ops, "_interpret", lambda: False)
+    monkeypatch.setattr(pallas_sort, "_interpret", lambda: False)
+    monkeypatch.setenv("CAUSE_TPU_SORT", "pallas")
+    monkeypatch.setenv("CAUSE_TPU_GATHER", "rowgather")
+    monkeypatch.setenv("CAUSE_TPU_SEARCH", "matrix-table")
+    monkeypatch.setenv("CAUSE_TPU_SCATTER", "hint")
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=120, n_div=40, capacity=256, hide_every=8
+    )
+    v5 = benchgen.batched_v5_inputs(batch, 256)
+    u = benchgen.v5_token_budget(v5)
+    args = [jnp.asarray(v5[k]) for k in LANE_KEYS5]
+
+    def f(*a):
+        return batched_merge_weave_v5(*a, u_max=u, k_max=u,
+                                      euler="walk")
+
+    batched_merge_weave_v5.clear_cache()
+    try:
+        jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+    finally:
+        batched_merge_weave_v5.clear_cache()
