@@ -36,6 +36,8 @@ import subprocess
 import sys
 import time
 
+from cause_tpu.switches import TRACE_SWITCHES  # dependency-free
+
 NORTH_STAR_MS = 100.0
 # generous: first XLA compile of the 1024x10k kernel + 4 timed reps
 FULL_TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", "1500"))
@@ -281,9 +283,7 @@ def measure(platform: str) -> dict:
     # compile can't eat the whole budget, and by BENCH_NO_ALLSTREAM
     # for the watcher's isolated A/B runs.
     preset = [f"{k.split('_')[-1].lower()}={os.environ[k]}"
-              for k in ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER",
-                        "CAUSE_TPU_SEARCH", "CAUSE_TPU_SCATTER")
-              if os.environ.get(k)]
+              for k in TRACE_SWITCHES if os.environ.get(k)]
     config = "+".join(preset) if preset else "default"
     # start gate only — a pathological allstream compile after it can
     # still hit the parent deadline, so the gate is conservative (the
@@ -331,8 +331,7 @@ def measure(platform: str) -> dict:
                   f"({type(e).__name__}: {str(e)[:120]}); "
                   "keeping default", file=sys.stderr)
         finally:
-            for k in ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER",
-                      "CAUSE_TPU_SEARCH", "CAUSE_TPU_SCATTER"):
+            for k in TRACE_SWITCHES:
                 os.environ.pop(k, None)
             jax.clear_caches()  # stale switch-traced programs
 
@@ -404,9 +403,7 @@ def main() -> None:
             # switches (128x rowgather amplification, matrix search)
             # are pessimizations on CPU. The CPU evidence always uses
             # the default ladder and default strategies.
-            for k in ("BENCH_KERNEL", "CAUSE_TPU_SORT",
-                      "CAUSE_TPU_GATHER", "CAUSE_TPU_SEARCH",
-                      "CAUSE_TPU_SCATTER"):
+            for k in ("BENCH_KERNEL",) + TRACE_SWITCHES:
                 env.pop(k, None)
         else:
             import glob

@@ -192,11 +192,9 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
 
     # the CAUSE_TPU_* streaming switches are read at TRACE time inside
     # the kernels, so they are part of the program identity
-    switches = tuple(
-        _os.environ.get(k, "") for k in
-        ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER", "CAUSE_TPU_SEARCH",
-         "CAUSE_TPU_SCATTER")
-    )
+    from .switches import TRACE_SWITCHES
+
+    switches = tuple(_os.environ.get(k, "") for k in TRACE_SWITCHES)
     key = (k_max, kernel if k_max > 0 else "v1", u_max, switches)
     program = _scalar_programs.get(key)
     if program is None:

@@ -1,0 +1,22 @@
+"""The canonical list of trace-time kernel strategy switches.
+
+Read from the environment AT TRACE TIME inside the weave kernels, so
+they are part of program identity: every cache key, env scrub, and A/B
+config driver must agree on this list or stale programs get served
+across configs / TPU pessimizations leak into CPU fallbacks. Import it
+— never restate it. Dependency-free on purpose: bench.py's parent
+process must be able to read it without importing jax.
+
+Values (all optional; unset = XLA default lowering):
+- CAUSE_TPU_SORT:    "bitonic" | "pallas"
+- CAUSE_TPU_GATHER:  "rowgather"
+- CAUSE_TPU_SEARCH:  "matrix" | "matrix-table"
+- CAUSE_TPU_SCATTER: "hint"
+"""
+
+TRACE_SWITCHES = (
+    "CAUSE_TPU_SORT",
+    "CAUSE_TPU_GATHER",
+    "CAUSE_TPU_SEARCH",
+    "CAUSE_TPU_SCATTER",
+)

@@ -49,8 +49,7 @@ STATE_PATH = os.path.join(
     "measurements", "harvest_state_r4.json",
 )
 
-SWITCHES = ("CAUSE_TPU_SORT", "CAUSE_TPU_GATHER",
-            "CAUSE_TPU_SEARCH", "CAUSE_TPU_SCATTER")
+from cause_tpu.switches import TRACE_SWITCHES as SWITCHES  # noqa: E402
 
 
 def emit(**obj):
