@@ -192,9 +192,11 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
 
     # the CAUSE_TPU_* streaming switches are read at TRACE time inside
     # the kernels, so they are part of the program identity
-    from .switches import TRACE_SWITCHES
+    from .switches import TRACE_SWITCHES, resolve
 
-    switches = tuple(_os.environ.get(k, "") for k in TRACE_SWITCHES)
+    # resolved (not raw-env) values: backend-conditional defaults are
+    # part of program identity too
+    switches = tuple(resolve(k) for k in TRACE_SWITCHES)
     key = (k_max, kernel if k_max > 0 else "v1", u_max, switches)
     program = _scalar_programs.get(key)
     if program is None:

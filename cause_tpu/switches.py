@@ -20,3 +20,34 @@ TRACE_SWITCHES = (
     "CAUSE_TPU_SEARCH",
     "CAUSE_TPU_SCATTER",
 )
+
+# Per-backend default strategies, applied when the env var is UNSET.
+# The chip A/B ladder (scripts/harvest.py) decides what goes here —
+# flipping a winner to default is a one-line change per switch. CPU
+# keeps XLA lowerings: the streaming strategies are TPU answers to
+# TPU costs (rowgather is a measured ~10x CPU pessimization).
+# The explicit env value "xla" forces the XLA-default lowering even
+# where a TPU default is set (so A/Bs can still measure the baseline).
+TPU_DEFAULTS: dict = {
+    # populated from measured chip wins; empty until then
+}
+
+
+def resolve(name: str) -> str:
+    """The effective strategy for ``name`` at trace time: the env var
+    if set ("xla" = force the XLA-default lowering), else the
+    backend's default. Reads the default backend, so call it only
+    inside traced/jitted code paths where backend init is already
+    acceptable (all current callers are kernel-trace sites)."""
+    import os
+
+    v = os.environ.get(name, "").strip()
+    if v:
+        return "" if v == "xla" else v
+    if not TPU_DEFAULTS:
+        return ""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return TPU_DEFAULTS.get(name, "")
+    return ""

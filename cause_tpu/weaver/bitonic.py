@@ -23,8 +23,6 @@ so hardware A/B needs no code change.
 
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
 from jax import lax
 
@@ -119,7 +117,9 @@ def sort_pairs(operands, num_keys: int = 1):
     same network VMEM-resident inside one Pallas kernel per 8-row
     block — one HBM read + write per operand total) for hardware A/B
     with no code change."""
-    mode = os.environ.get("CAUSE_TPU_SORT", "").strip()
+    from ..switches import resolve
+
+    mode = resolve("CAUSE_TPU_SORT")
     if mode == "bitonic":
         return bitonic_sort(operands, num_keys=num_keys)
     if mode == "pallas":

@@ -14,8 +14,6 @@ hardware A/B needs no code change (same discipline as
 
 from __future__ import annotations
 
-import os
-
 import jax.numpy as jnp
 from jax import lax
 
@@ -59,7 +57,9 @@ def take1d(table, idx):
     """The kernels' gather from a full-width lane table: plain XLA
     gather by default, ``rowgather1d`` when
     ``CAUSE_TPU_GATHER=rowgather`` (trace-time switch)."""
-    if os.environ.get("CAUSE_TPU_GATHER", "").strip() == "rowgather":
+    from ..switches import resolve
+
+    if resolve("CAUSE_TPU_GATHER") == "rowgather":
         return rowgather1d(table, idx)
     return table[idx]
 
@@ -81,7 +81,9 @@ def searchsorted_iota_right(keys_cum, q: int):
     S-width table search in jaxw5 and leaves this histogram alone —
     that is what the combined beststream config uses until the
     microbench decides."""
-    if os.environ.get("CAUSE_TPU_SEARCH", "").strip() == "matrix":
+    from ..switches import resolve
+
+    if resolve("CAUSE_TPU_SEARCH") == "matrix":
         tgt = jnp.arange(q, dtype=keys_cum.dtype)
         le = keys_cum[None, :] <= tgt[:, None]
         return jnp.sum(le, axis=1).astype(jnp.int32)

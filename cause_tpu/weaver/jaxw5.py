@@ -48,13 +48,13 @@ unflagged; the API paths validate bodies host-side
 
 from __future__ import annotations
 
-import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..switches import resolve
 from .arrays import I32_MAX, VCLASS_H_HIDE, VCLASS_HIDE
 from .jaxw import _euler_rank, _link_children
 from .jaxw3 import _shift1
@@ -85,7 +85,7 @@ def _hint_kw(sorted_: bool = False, unique: bool = False) -> dict:
     past the live range instead of sharing one dump index — so the
     annotations are provable, not merely test-passing. Off by default
     so the hardware A/B isolates their effect."""
-    if os.environ.get("CAUSE_TPU_SCATTER", "").strip() != "hint":
+    if resolve("CAUSE_TPU_SCATTER") != "hint":
         return {}
     kw = {}
     if sorted_:
@@ -132,8 +132,7 @@ def _pair_search_le(kh, kl, qh, ql, size):
     (``matrix-table`` applies matrix search HERE only, leaving the
     U-width searchsorted histogram in gatherops untouched — see its
     docstring for why.)"""
-    if os.environ.get("CAUSE_TPU_SEARCH", "").strip() in (
-            "matrix", "matrix-table"):
+    if resolve("CAUSE_TPU_SEARCH") in ("matrix", "matrix-table"):
         le = _le(kh[None, :], kl[None, :], qh[:, None], ql[:, None])
         return jnp.sum(le, axis=1).astype(jnp.int32) - 1
 
@@ -340,8 +339,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # stays. Identical results either way: same keys, same implicit
     # -iota stability, and payload-carry == gather-by-permutation.
     su_src_in = uidx
-    ride = os.environ.get("CAUSE_TPU_SORT", "").strip() in (
-        "bitonic", "pallas")
+    ride = resolve("CAUSE_TPU_SORT") in ("bitonic", "pallas")
     if ride:
         (st_hi, st_lo, t_src, sv_len, sv_vc, sv_tsp_i,
          sv_lane) = sort_pairs(

@@ -214,10 +214,12 @@ def main() -> None:
                     o = dispatch(kernel, k)
                 np.asarray(o)
                 bursts.append((time.perf_counter() - t0) * 1000 / burst_n)
+            label = "+".join(
+                f"{k_.split('_')[-1].lower()}={v}"
+                for k_, v in sorted(cfg.items()) if v != "xla")
             emit(ev="result", item=name, kernel=kernel,
-                 config="+".join(f"{k_.split('_')[-1].lower()}={v}"
-                                 for k_, v in sorted(cfg.items()))
-                        or "default",
+                 config=label or ("xla-baseline" if cfg
+                                  else "shipped-default"),
                  p50_single_ms=round(float(np.median(singles)), 1),
                  p50_amortized_ms=round(float(np.median(bursts)), 1),
                  singles_ms=[round(x, 1) for x in singles],
@@ -286,8 +288,11 @@ def main() -> None:
                      prefix_ms=round(med, 1),
                      incr_ms=round(med - prev, 1), platform=plat)
                 prev = med
+            label = "+".join(sorted(
+                v for v in cfg.values() if v != "xla"))
             emit(ev="result", item=name, stages=table, platform=plat,
-                 config="+".join(sorted(cfg.values())) or "default",
+                 config=label or ("xla-baseline" if cfg
+                                  else "shipped-default"),
                  u_max=int(u_eff), shape=f"{B}x{1+NB+ND}")
             if record_state:
                 done.add(name)
@@ -373,15 +378,27 @@ def main() -> None:
             emit(ev="error", item=name,
                  error=f"{type(e).__name__}: {str(e)[:200]}")
 
-    ALLSTREAM = {"CAUSE_TPU_SORT": "bitonic",
-                 "CAUSE_TPU_GATHER": "rowgather",
-                 "CAUSE_TPU_SEARCH": "matrix"}
+    # Every item pins the FULL switch set explicitly ("xla" = force
+    # the XLA-default lowering), so the ladder keeps measuring true
+    # baselines even after chip wins are flipped into
+    # switches.TPU_DEFAULTS — otherwise single-switch A/Bs would
+    # silently become winner-vs-winner (round-4 review finding).
+    XLA_BASE = {k: "xla" for k in SWITCHES}
+
+    def cfg_of(**over):
+        out = dict(XLA_BASE)
+        out.update(over)
+        return out
+
+    ALLSTREAM = cfg_of(CAUSE_TPU_SORT="bitonic",
+                       CAUSE_TPU_GATHER="rowgather",
+                       CAUSE_TPU_SEARCH="matrix")
     # the round-4 headline candidate: VMEM-resident pallas sort +
     # streaming gathers + matrix search + sequential euler walk
-    BESTSTREAM = {"CAUSE_TPU_SORT": "pallas",
-                  "CAUSE_TPU_GATHER": "rowgather",
-                  "CAUSE_TPU_SEARCH": "matrix-table",
-                  "CAUSE_TPU_SCATTER": "hint"}
+    BESTSTREAM = cfg_of(CAUSE_TPU_SORT="pallas",
+                        CAUSE_TPU_GATHER="rowgather",
+                        CAUSE_TPU_SEARCH="matrix-table",
+                        CAUSE_TPU_SCATTER="hint")
 
     # ---- the ladder, highest information value per second first -----
     # (1) headline, always re-measured; (2) phase attribution decides
@@ -390,28 +407,30 @@ def main() -> None:
     # v4 ladder point.
     ladder: list[tuple[str, object, tuple]] = [
         ("bench_v5", bench_item, ("bench_v5", "v5", {}, 8, False)),
-        ("stages_default", stages_item, ("stages_default", {})),
+        ("stages_default", stages_item, ("stages_default", XLA_BASE)),
         ("bench_beststream", bench_item,
          ("bench_beststream", "v5w", BESTSTREAM)),
+        ("bench_xla_base", bench_item,
+         ("bench_xla_base", "v5", XLA_BASE)),
         ("bench_psort", bench_item,
-         ("bench_psort", "v5", {"CAUSE_TPU_SORT": "pallas"})),
-        ("bench_v5w", bench_item, ("bench_v5w", "v5w", {})),
+         ("bench_psort", "v5", cfg_of(CAUSE_TPU_SORT="pallas"))),
+        ("bench_v5w", bench_item, ("bench_v5w", "v5w", XLA_BASE)),
         ("bench_rowgather", bench_item,
-         ("bench_rowgather", "v5", {"CAUSE_TPU_GATHER": "rowgather"})),
+         ("bench_rowgather", "v5", cfg_of(CAUSE_TPU_GATHER="rowgather"))),
         ("bench_matrix", bench_item,
-         ("bench_matrix", "v5", {"CAUSE_TPU_SEARCH": "matrix"})),
+         ("bench_matrix", "v5", cfg_of(CAUSE_TPU_SEARCH="matrix"))),
         ("bench_schint", bench_item,
-         ("bench_schint", "v5", {"CAUSE_TPU_SCATTER": "hint"})),
+         ("bench_schint", "v5", cfg_of(CAUSE_TPU_SCATTER="hint"))),
         ("bench_allstream", bench_item,
          ("bench_allstream", "v5", ALLSTREAM)),
         ("bench_bitonic", bench_item,
-         ("bench_bitonic", "v5", {"CAUSE_TPU_SORT": "bitonic"})),
+         ("bench_bitonic", "v5", cfg_of(CAUSE_TPU_SORT="bitonic"))),
         ("stages_beststream", stages_item,
          ("stages_beststream", BESTSTREAM)),
         ("microbench", micro_item, ("microbench",)),
         ("fleet64", fleet_item, ("fleet64", 64, 2_000, 200, 2_560)),
         ("fleet256", fleet_item, ("fleet256", 256, 500, 64, 1_024)),
-        ("bench_v4", bench_item, ("bench_v4", "v4", {})),
+        ("bench_v4", bench_item, ("bench_v4", "v4", XLA_BASE)),
         # bookend repeat of the headline (cross-window repetition)
         ("bench_v5_bookend", bench_item,
          ("bench_v5_bookend", "v5", {}, 8, False)),
