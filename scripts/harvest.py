@@ -1,4 +1,4 @@
-"""Round-4 TPU window harvester: the WHOLE measurement ladder in ONE
+"""Round-5 TPU window harvester: the WHOLE measurement ladder in ONE
 tunnel claim.
 
 Round 3's hard lesson: the axon tunnel granted exactly one ~6-minute
@@ -24,9 +24,17 @@ Design rules (from rounds 2-3):
   the cached default program.
 
 State: completed one-shot items are recorded in
-``measurements/harvest_state_r4.json`` and skipped on later attempts;
+``measurements/harvest_state_r5.json`` and skipped on later attempts;
 the headline bench (``bench_v5``) is always re-measured — repetition
 across windows is the point (VERDICT weak #1).
+
+Round 5 adds an on-chip correctness gate (``verify_beststream``,
+ADVICE.md #3): per-row avalanche digests of the full batch under the
+pinned XLA baseline vs the beststream config. On MISMATCH it
+attributes the culprit by re-digesting one switch at a time, and every
+timing item whose config contains a suspect strategy is skipped for
+the window (timing a wrong kernel is not evidence) — suspect skips
+still count as attempted so the watcher can advance phases.
 
 Usage: python -u scripts/harvest.py  [--smoke] [--allow-cpu]
 """
@@ -46,7 +54,7 @@ import numpy as np
 T0 = time.monotonic()
 STATE_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-    "measurements", "harvest_state_r4.json",
+    "measurements", "harvest_state_r5.json",
 )
 
 from cause_tpu.switches import TRACE_SWITCHES as SWITCHES  # noqa: E402
@@ -177,6 +185,43 @@ def main() -> None:
     # trace-time switches never change token/run counts — so one
     # validation per kernel family covers every config)
     validated_k: dict = {}
+    # strategy values that failed the on-chip digest gate this attempt
+    # ("pallas", "hint", ... or "v5w" for the euler walk); items whose
+    # config uses one are skipped-as-attempted, not timed
+    suspect_values: set = set()
+    skipped_suspect: set = set()
+
+    def effective_values(kernel, cfg) -> set:
+        """The strategy values an item actually runs with: the explicit
+        cfg, plus — for switches the cfg leaves unset (shipped-default
+        items use cfg={}) — the backend defaults switches.resolve()
+        would apply on TPU. Without the union, the headline/fleet items
+        would bypass the suspect gate the moment a win is promoted
+        into TPU_DEFAULTS."""
+        from cause_tpu.switches import TPU_DEFAULTS
+
+        vals = set()
+        for k_ in SWITCHES:
+            v = cfg.get(k_, "")
+            if not v and plat == "tpu":
+                v = TPU_DEFAULTS.get(k_, "")
+            if v and v != "xla":
+                vals.add(v)
+        if kernel in ("v5w", "v4w"):
+            vals.add("v5w")
+        return vals
+
+    def suspect_gate(name, kernel, cfg) -> bool:
+        """True (and emits the skip) when the item's effective config
+        contains a strategy the digest gate flagged this attempt."""
+        bad = effective_values(kernel, cfg) & suspect_values
+        if bad:
+            emit(ev="skip", item=name,
+                 reason=f"config uses digest-mismatching strategies "
+                        f"{sorted(bad)}; not timing a wrong kernel")
+            skipped_suspect.add(name)
+            return True
+        return False
 
     def dispatch(kernel, k):
         lanes = (LANE_KEYS5 if kernel in ("v5", "v5w")
@@ -190,6 +235,8 @@ def main() -> None:
     def bench_item(name, kernel, cfg, burst_n=8, record=True):
         """bench.py-methodology measurement of one kernel+config:
         single-dispatch p50 and amortized-burst p50, reps each."""
+        if suspect_gate(name, kernel, cfg):
+            return
         set_config(cfg)
         k = u_budget if kernel in ("v5", "v5w") else budget
         try:
@@ -234,6 +281,108 @@ def main() -> None:
         finally:
             set_config({})
 
+    def verify_item(name, cfg_a, kernel_b, cfg_b):
+        """On-chip correctness gate (round-4 advisor finding): the
+        streaming strategies and the Mosaic-compiled pallas kernels are
+        parity-validated only in interpret/CPU mode — a wrong scatter
+        hint or Mosaic lowering on real TPU would produce silently
+        wrong results that the timing ladder would happily measure.
+        Before any config A/B is trusted, compare exact per-row
+        avalanche digests (mesh.replica_digest-style mixing — a plain
+        linear weighted sum was observed cancelling compensating errors
+        into collisions) of the FULL batch under the pinned
+        XLA-baseline ``cfg_a`` (NOT the shipped default, which becomes
+        suspect-vs-suspect the moment a pallas win lands in
+        switches.TPU_DEFAULTS) against ``cfg_b``. Requires a
+        bench-validated v5 budget (same precondition as stages_item:
+        truncated programs clamp identically and would certify a false
+        MATCH); done only on MATCH with zero overflow on both sides."""
+        from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+
+        if "v5" not in validated_k:
+            emit(ev="error", item=name,
+                 error="no bench-validated v5 budget this attempt; "
+                       "skipping verify rather than digest a possibly "
+                       "truncated program")
+            return
+        k = validated_k["v5"]
+
+        def digests(kernel, cfg):
+            set_config(cfg)
+            euler = "walk" if kernel == "v5w" else "doubling"
+
+            @jax.jit
+            def prog(*a):
+                rank, vis, conflict, ovf = batched_merge_weave_v5(
+                    *a, u_max=k, k_max=k, euler=euler
+                )
+                lane = jax.lax.broadcasted_iota(
+                    jnp.uint32, rank.shape, 1)
+                x = (rank.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+                     + vis.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+                     + lane * jnp.uint32(0xC2B2AE35)
+                     + jnp.uint32(1))
+                x = x ^ (x >> 16)
+                x = x * jnp.uint32(0x85EBCA6B)
+                x = x ^ (x >> 13)
+                x = x * jnp.uint32(0xC2B2AE35)
+                x = x ^ (x >> 16)
+                # conflict is a per-row output too — a strategy wrong
+                # only in conflict must not certify MATCH
+                return (jnp.sum(x, axis=1)
+                        ^ (conflict.astype(jnp.uint32)
+                           * jnp.uint32(0x27D4EB2F)),
+                        jnp.sum(ovf.astype(jnp.int32)))
+
+            out = prog(*[dev[n] for n in LANE_KEYS5])
+            return tuple(np.asarray(x) for x in out)
+
+        try:
+            da, ova = digests("v5", cfg_a)
+            db, ovb = digests(kernel_b, cfg_b)
+            mism = int(np.sum(da != db))
+            ok = mism == 0 and ova == 0 and ovb == 0
+            emit(ev="result", item=name, mismatch_rows=mism,
+                 overflow_a=int(ova), overflow_b=int(ovb),
+                 rows=int(da.shape[0]), platform=plat,
+                 verdict="MATCH" if ok else "MISMATCH")
+            if ok:
+                if record_state:
+                    done.add(name)
+                    save_state(done)
+                return
+            # attribute the culprit: one switch (or the euler walk)
+            # at a time against the same baseline digests
+            singles = [("v5", dict(cfg_a, **{k_: v}), v)
+                       for k_, v in cfg_b.items() if v != "xla"]
+            if kernel_b in ("v5w", "v4w"):
+                singles.append(("v5w", dict(cfg_a), "v5w"))
+            for kern, cfg1, val in singles:
+                d1, ov1 = digests(kern, cfg1)
+                m1 = int(np.sum(da != d1))
+                if m1 or ov1 != ova:
+                    suspect_values.add(val)
+                emit(ev="verify_attr", item=name, strategy=val,
+                     mismatch_rows=m1, overflow=int(ov1),
+                     platform=plat)
+            if not suspect_values:
+                # combination-only defect: no single strategy
+                # reproduces it, so every strategy in the failing
+                # config is suspect — better to skip them all than to
+                # time and permanently record a known-wrong config
+                suspect_values.update(
+                    v for v in cfg_b.values() if v != "xla")
+                if kernel_b in ("v5w", "v4w"):
+                    suspect_values.add("v5w")
+                emit(ev="verify_attr", item=name,
+                     strategy="combination-only",
+                     note="no single culprit; all strategies of the "
+                          "failing config marked suspect")
+            emit(ev="suspects", item=name,
+                 suspects=sorted(suspect_values))
+        finally:
+            set_config({})
+
     def stages_item(name, cfg):
         """Cumulative-prefix phase attribution ON HARDWARE (jaxw5
         stage= early returns with live checksums; probe_v5_stages
@@ -244,6 +393,8 @@ def main() -> None:
         practice always) — the stage checksums fold the overflow flag
         into a float, so an unvalidated budget could silently time a
         truncated program."""
+        if suspect_gate(name, "v5", cfg):
+            return
         if "v5" not in validated_k:
             # without a bench-validated budget the stage checksums could
             # silently time a truncated (overflowed) program AND mark
@@ -408,6 +559,8 @@ def main() -> None:
     ladder: list[tuple[str, object, tuple]] = [
         ("bench_v5", bench_item, ("bench_v5", "v5", {}, 8, False)),
         ("stages_default", stages_item, ("stages_default", XLA_BASE)),
+        ("verify_beststream", verify_item,
+         ("verify_beststream", XLA_BASE, "v5w", BESTSTREAM)),
         ("bench_beststream", bench_item,
          ("bench_beststream", "v5w", BESTSTREAM)),
         ("bench_xla_base", bench_item,
@@ -447,8 +600,16 @@ def main() -> None:
             emit(ev="error", item=name,
                  error=f"{type(e).__name__}: {str(e)[:300]}")
 
+    # suspect skips count as attempted (re-measuring a digest
+    # -mismatching config in a later window yields the same skip; the
+    # watcher must be able to advance to phases 2-3); verify itself
+    # also counts as attempted on MISMATCH — it re-runs next window
+    # anyway because it is not in ``done``
+    attempted = done | skipped_suspect
+    if suspect_values:
+        attempted.add("verify_beststream")
     complete = all(
-        name in done for name, _, _ in ladder
+        name in attempted for name, _, _ in ladder
         if name not in ("bench_v5", "bench_v5_bookend")
     )
     emit(ev="done", complete=complete, platform=plat)
