@@ -12,6 +12,9 @@ Values (all optional; unset = XLA default lowering):
 - CAUSE_TPU_GATHER:  "rowgather"
 - CAUSE_TPU_SEARCH:  "matrix" | "matrix-table"
 - CAUSE_TPU_SCATTER: "hint"
+- CAUSE_TPU_FPHASE:  "pallas" (v5 lane expansion as the fused
+  tile-window kernel, weaver/pallas_fphase.py; falls back to the XLA
+  form when the concat width is not a multiple of 128)
 """
 
 TRACE_SWITCHES = (
@@ -19,6 +22,7 @@ TRACE_SWITCHES = (
     "CAUSE_TPU_GATHER",
     "CAUSE_TPU_SEARCH",
     "CAUSE_TPU_SCATTER",
+    "CAUSE_TPU_FPHASE",
 )
 
 # Per-backend default strategies, applied when the env var is UNSET.

@@ -563,6 +563,39 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     else:
         lk, tok_at = sort_pairs((lane_key, uidx), num_keys=1)
         tb_l = take1d(rank_tok, tok_at)
+
+    # pieces shared by both F backends: per-lane coverage flags, the
+    # token-level kill scatters (victims can duplicate — genuine
+    # scatters, U-width, stay in XLA either way), and the root lane
+    seg_cov = sg_valid & take1d(survive, inv_s)
+    killed_sc = jnp.zeros(N + 1, bool)
+    killed_sc = killed_sc.at[jnp.where(kg, vict_inrun, N)].set(
+        True, mode="drop")
+    killed_sc = killed_sc.at[jnp.where(kill_tail, vict_tail, N)].set(
+        True, mode="drop")
+    root_lane = jnp.zeros(N, bool).at[
+        jnp.clip(sv_lane[0], 0, N - 1)
+    ].set(keep_t[0])
+
+    if resolve("CAUSE_TPU_FPHASE") == "pallas" and N % 128 == 0:
+        # fused tile-window expansion (pallas_fphase): no scatters, no
+        # cumsums — per-tile compare-select windows in VMEM compute
+        # the fills and coverage; visibility is a vectorized second
+        # pass in the same kernel
+        from .pallas_fphase import fphase_expand
+
+        cov_start = jnp.where(seg_cov, sg_lane0, N).astype(jnp.int32)
+        cov_end = jnp.where(
+            seg_cov, sg_lane0 + sg_len, 0).astype(jnp.int32)
+        cs, ce = sort_pairs((cov_start, cov_end), num_keys=1)
+        killed_ext = killed_sc[:N] | root_lane
+        flags = (valid.astype(jnp.int32)
+                 | (killed_ext.astype(jnp.int32) << 1))
+        rank_lane, visible = fphase_expand(
+            lk, tb_l, cs, ce, vclass, seg, flags)
+        overflow = overflow_u | overflow_k
+        return rank_lane, visible, conflict, overflow
+
     tl_l = jnp.where(lk < N, lk, 0)
     ok_l = lk < N
     d_base = jnp.where(
@@ -606,7 +639,6 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # per-lane coverage flags from the segment tables (marshal order =
     # ascending lane order): covered = lane belongs to a token that is
     # kept, either via its own token (exploded) or its segment's token
-    seg_cov = sg_valid & take1d(survive, inv_s)
     # spread dump slots past N keep both index streams unique (segment
     # starts/ends are distinct for live segments: disjoint ascending)
     cov_cnt = jnp.zeros(N + 1 + S, jnp.int32)
@@ -636,16 +668,7 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     ])
     nxt_hide = jnp.concatenate([hideish_l[1:], jnp.zeros((1,), bool)])
     kill_in_seg = in_surviving & nxt_same_seg & nxt_hide
-
-    killed = jnp.zeros(N + 1, bool)
-    killed = killed.at[jnp.where(kg, vict_inrun, N)].set(True, mode="drop")
-    killed = killed.at[jnp.where(kill_tail, vict_tail, N)].set(
-        True, mode="drop")
-    killed = killed[:N] | kill_in_seg
-
-    root_lane = jnp.zeros(N, bool).at[
-        jnp.clip(sv_lane[0], 0, N - 1)
-    ].set(keep_t[0])
+    killed = killed_sc[:N] | kill_in_seg
 
     visible = (
         valid & (rank_lane < N) & (vclass == 0) & ~root_lane & ~killed

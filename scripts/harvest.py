@@ -59,6 +59,33 @@ STATE_PATH = os.path.join(
 
 from cause_tpu.switches import TRACE_SWITCHES as SWITCHES  # noqa: E402
 
+# Every item pins the FULL switch set explicitly ("xla" = force the
+# XLA-default lowering), so the ladder keeps measuring true baselines
+# even after chip wins are flipped into switches.TPU_DEFAULTS —
+# otherwise single-switch A/Bs would silently become winner-vs-winner
+# (round-4 review finding). Module-level so the watcher derives its
+# phase-2 env from here instead of restating it (drift trap).
+XLA_BASE = {k: "xla" for k in SWITCHES}
+
+
+def cfg_of(**over):
+    out = dict(XLA_BASE)
+    out.update(over)
+    return out
+
+
+ALLSTREAM = cfg_of(CAUSE_TPU_SORT="bitonic",
+                   CAUSE_TPU_GATHER="rowgather",
+                   CAUSE_TPU_SEARCH="matrix")
+# the round-5 headline candidate: VMEM-resident pallas sort +
+# streaming gathers + matrix search + sequential euler walk +
+# the fused F-phase tile-window expansion (round 5)
+BESTSTREAM = cfg_of(CAUSE_TPU_SORT="pallas",
+                    CAUSE_TPU_GATHER="rowgather",
+                    CAUSE_TPU_SEARCH="matrix-table",
+                    CAUSE_TPU_SCATTER="hint",
+                    CAUSE_TPU_FPHASE="pallas")
+
 
 def emit(**obj):
     obj["t"] = round(time.monotonic() - T0, 1)
@@ -185,14 +212,16 @@ def main() -> None:
     # trace-time switches never change token/run counts — so one
     # validation per kernel family covers every config)
     validated_k: dict = {}
-    # strategy values that failed the on-chip digest gate this attempt
-    # ("pallas", "hint", ... or "v5w" for the euler walk); items whose
-    # config uses one are skipped-as-attempted, not timed
+    # strategies that failed the on-chip digest gate this attempt,
+    # keyed as "SWITCH=value" pairs ("euler=walk" for the v5w/v4w
+    # kernels) — bare values would collide ("pallas" names both the
+    # sort and the fphase strategy) and wrongly quarantine the other;
+    # items whose config uses a suspect pair are skipped-as-attempted
     suspect_values: set = set()
     skipped_suspect: set = set()
 
     def effective_values(kernel, cfg) -> set:
-        """The strategy values an item actually runs with: the explicit
+        """The strategy pairs an item actually runs with: the explicit
         cfg, plus — for switches the cfg leaves unset (shipped-default
         items use cfg={}) — the backend defaults switches.resolve()
         would apply on TPU. Without the union, the headline/fleet items
@@ -206,9 +235,9 @@ def main() -> None:
             if not v and plat == "tpu":
                 v = TPU_DEFAULTS.get(k_, "")
             if v and v != "xla":
-                vals.add(v)
+                vals.add(f"{k_}={v}")
         if kernel in ("v5w", "v4w"):
-            vals.add("v5w")
+            vals.add("euler=walk")
         return vals
 
     def suspect_gate(name, kernel, cfg) -> bool:
@@ -353,10 +382,10 @@ def main() -> None:
                 return
             # attribute the culprit: one switch (or the euler walk)
             # at a time against the same baseline digests
-            singles = [("v5", dict(cfg_a, **{k_: v}), v)
+            singles = [("v5", dict(cfg_a, **{k_: v}), f"{k_}={v}")
                        for k_, v in cfg_b.items() if v != "xla"]
             if kernel_b in ("v5w", "v4w"):
-                singles.append(("v5w", dict(cfg_a), "v5w"))
+                singles.append(("v5w", dict(cfg_a), "euler=walk"))
             for kern, cfg1, val in singles:
                 d1, ov1 = digests(kern, cfg1)
                 m1 = int(np.sum(da != d1))
@@ -371,9 +400,10 @@ def main() -> None:
                 # config is suspect — better to skip them all than to
                 # time and permanently record a known-wrong config
                 suspect_values.update(
-                    v for v in cfg_b.values() if v != "xla")
+                    f"{k_}={v}" for k_, v in cfg_b.items()
+                    if v != "xla")
                 if kernel_b in ("v5w", "v4w"):
-                    suspect_values.add("v5w")
+                    suspect_values.add("euler=walk")
                 emit(ev="verify_attr", item=name,
                      strategy="combination-only",
                      note="no single culprit; all strategies of the "
@@ -529,28 +559,6 @@ def main() -> None:
             emit(ev="error", item=name,
                  error=f"{type(e).__name__}: {str(e)[:200]}")
 
-    # Every item pins the FULL switch set explicitly ("xla" = force
-    # the XLA-default lowering), so the ladder keeps measuring true
-    # baselines even after chip wins are flipped into
-    # switches.TPU_DEFAULTS — otherwise single-switch A/Bs would
-    # silently become winner-vs-winner (round-4 review finding).
-    XLA_BASE = {k: "xla" for k in SWITCHES}
-
-    def cfg_of(**over):
-        out = dict(XLA_BASE)
-        out.update(over)
-        return out
-
-    ALLSTREAM = cfg_of(CAUSE_TPU_SORT="bitonic",
-                       CAUSE_TPU_GATHER="rowgather",
-                       CAUSE_TPU_SEARCH="matrix")
-    # the round-4 headline candidate: VMEM-resident pallas sort +
-    # streaming gathers + matrix search + sequential euler walk
-    BESTSTREAM = cfg_of(CAUSE_TPU_SORT="pallas",
-                        CAUSE_TPU_GATHER="rowgather",
-                        CAUSE_TPU_SEARCH="matrix-table",
-                        CAUSE_TPU_SCATTER="hint")
-
     # ---- the ladder, highest information value per second first -----
     # (1) headline, always re-measured; (2) phase attribution decides
     # the round's direction; (3) the best-guess combined config; then
@@ -574,6 +582,8 @@ def main() -> None:
          ("bench_matrix", "v5", cfg_of(CAUSE_TPU_SEARCH="matrix"))),
         ("bench_schint", bench_item,
          ("bench_schint", "v5", cfg_of(CAUSE_TPU_SCATTER="hint"))),
+        ("bench_fphase", bench_item,
+         ("bench_fphase", "v5", cfg_of(CAUSE_TPU_FPHASE="pallas"))),
         ("bench_allstream", bench_item,
          ("bench_allstream", "v5", ALLSTREAM)),
         ("bench_bitonic", bench_item,

@@ -78,20 +78,24 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
   # the predicted-winner kernel config (bit-identical by the combined
   # parity suite; worst case a slower but still-valid chip number)
   elif [ ! -e measurements/api_wave_tpu_r5.ok ]; then
-    # beststream config UNLESS the harvest's on-chip digest gate
-    # recorded suspects — then the shipped default (still a valid
-    # chip number; timing a digest-mismatching kernel is not)
-    if grep -qs '"ev": "suspects"' measurements/harvest_tpu_r5.log; then
-      note "attempt $i: api_bench wave (default config; verify gate recorded suspects)"
+    # beststream config only once the digest gate CERTIFIED it (the
+    # state file records verify_beststream on MATCH; a stale suspects
+    # log line from an earlier window must not demote a later-fixed
+    # config, and an uncertified config must not produce the round's
+    # wave number). Env derives from harvest.BESTSTREAM — restating
+    # it here is the drift trap switches.py warns about.
+    if grep -qs '"verify_beststream"' measurements/harvest_state_r5.json 2>/dev/null; then
+      BS_ENV=$(PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -c "
+import sys; sys.path.insert(0, 'scripts'); import harvest
+print(' '.join(f'{k}={v}' for k, v in sorted(harvest.BESTSTREAM.items())))")
+      note "attempt $i: api_bench wave (certified beststream: $BS_ENV)"
       HARVEST_CLAIM_DEADLINE=$(claim_remain) \
-        python -u scripts/api_bench.py --wave 1024 \
+        env $BS_ENV python -u scripts/api_bench.py --wave 1024 \
         >> measurements/api_wave_tpu_r5.log \
         2>> measurements/api_wave_tpu_r5.err 9>&-
     else
-      note "attempt $i: api_bench wave (beststream config)"
+      note "attempt $i: api_bench wave (shipped default; beststream not digest-certified)"
       HARVEST_CLAIM_DEADLINE=$(claim_remain) \
-        CAUSE_TPU_SORT=pallas CAUSE_TPU_GATHER=rowgather \
-        CAUSE_TPU_SEARCH=matrix-table CAUSE_TPU_SCATTER=hint \
         python -u scripts/api_bench.py --wave 1024 \
         >> measurements/api_wave_tpu_r5.log \
         2>> measurements/api_wave_tpu_r5.err 9>&-

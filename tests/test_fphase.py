@@ -1,0 +1,179 @@
+"""Bit-exactness of the fused F-phase (CAUSE_TPU_FPHASE=pallas,
+weaver/pallas_fphase.py) against the XLA scatter+cumsum form.
+
+The XLA form is itself parity-pinned against v1 and the pure oracle
+(tests/test_jax_v5.py), so exact array equality of all four kernel
+outputs under the switch is the full correctness statement. The
+Mosaic lowering is guarded in tests/test_pallas_lowering.py."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import cause_tpu as c
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS5
+from cause_tpu.ids import new_site_id
+from cause_tpu.weaver.jaxw5 import (batched_merge_weave_v5,
+                                    merge_weave_kernel_v5_jit)
+
+from test_list import rand_node
+
+OUT_NAMES = ("rank", "visible", "conflict", "overflow")
+
+
+@pytest.fixture
+def fphase(monkeypatch):
+    """Runs the body twice via the returned helper: once default, once
+    fused; clears the jit caches around each flip (trace-time env)."""
+
+    def both(fn):
+        monkeypatch.delenv("CAUSE_TPU_FPHASE", raising=False)
+        jax.clear_caches()
+        base = [np.asarray(x) for x in fn()]
+        monkeypatch.setenv("CAUSE_TPU_FPHASE", "pallas")
+        jax.clear_caches()
+        try:
+            got = [np.asarray(x) for x in fn()]
+        finally:
+            monkeypatch.delenv("CAUSE_TPU_FPHASE")
+            jax.clear_caches()
+        return base, got
+
+    return both
+
+
+def assert_equal_outputs(base, got, tag=""):
+    for b, g, name in zip(base, got, OUT_NAMES):
+        assert np.array_equal(b, g), (
+            f"{tag} {name} diverged at "
+            f"{np.flatnonzero((b != g).ravel())[:8]}"
+        )
+
+
+@pytest.mark.parametrize(
+    "B,nb,nd,cap,he",
+    [
+        (3, 120, 40, 256, 8),   # odd B: pads to the 8-row block
+        (8, 120, 40, 192, 4),   # N=384
+        (12, 400, 100, 640, 8),
+        (5, 60, 3, 64, 2),      # tiny N=128 (window == whole width)
+        (4, 0, 30, 64, 3),      # no shared base
+        (2, 30, 10, 64, 0),     # no tombstones
+    ],
+)
+def test_batched_parity(fphase, B, nb, nd, cap, he):
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=nb, n_div=nd, capacity=cap, hide_every=he
+    )
+    v5b = benchgen.batched_v5_inputs(batch, cap)
+    u = benchgen.v5_token_budget(v5b)
+    args = [jnp.asarray(v5b[k]) for k in LANE_KEYS5]
+
+    def run():
+        return jax.jit(
+            lambda *a: batched_merge_weave_v5(*a, u_max=u, k_max=u)
+        )(*args)
+
+    base, got = fphase(run)
+    assert not base[3].any(), "unexpected overflow in baseline"
+    assert_equal_outputs(base, got, f"B={B} cap={cap}")
+
+
+def test_single_row_parity(fphase):
+    row = benchgen.divergent_pair_lanes(
+        n_base=100, n_div=40, capacity=192, hide_every=5
+    )
+    v5row = benchgen.v5_inputs(row, 192)
+    u = benchgen.v5_token_budget(v5row)
+    args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+
+    def run():
+        return merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+
+    base, got = fphase(run)
+    assert_equal_outputs(base, got, "single")
+
+
+def test_non_multiple_of_128_falls_back(fphase):
+    """N % 128 != 0 routes to the XLA form even under the switch —
+    same code both times, but the route must not crash or drift."""
+    row = benchgen.divergent_pair_lanes(
+        n_base=30, n_div=10, capacity=72, hide_every=3  # N = 144
+    )
+    v5row = benchgen.v5_inputs(row, 72)
+    u = benchgen.v5_token_budget(v5row)
+    args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+
+    def run():
+        return merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+
+    base, got = fphase(run)
+    assert_equal_outputs(base, got, "fallback")
+
+
+def test_overflow_flag_parity(fphase):
+    """An undersized token budget must flag overflow identically (the
+    outputs themselves are unspecified on overflow)."""
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=100, n_div=60, capacity=192, hide_every=4
+    )
+    v5b = benchgen.batched_v5_inputs(batch, 192)
+    args = [jnp.asarray(v5b[k]) for k in LANE_KEYS5]
+
+    def run():
+        return jax.jit(
+            lambda *a: batched_merge_weave_v5(*a, u_max=16, k_max=16)
+        )(*args)
+
+    base, got = fphase(run)
+    assert base[3].any()
+    assert np.array_equal(base[3], got[3])
+
+
+def _api_concat_row(handles, cap):
+    """Concat real API trees' lane rows (one interner domain)."""
+    from cause_tpu.weaver.arrays import NodeArrays, SiteInterner
+
+    interner = SiteInterner(
+        nid[1] for h in handles for nid in h.ct.nodes)
+    rows = []
+    for t, h in enumerate(handles):
+        na = NodeArrays.from_nodes_map(h.ct.nodes, cap, interner)
+        hi, lo = na.id_lanes()
+        cci = np.where(na.cause_idx >= 0,
+                       na.cause_idx + t * cap, -1).astype(np.int32)
+        rows.append({"hi": hi, "lo": lo, "cci": cci,
+                     "vc": na.vclass, "valid": na.valid})
+    return {k: np.concatenate([r[k] for r in rows]) for k in rows[0]}
+
+
+def test_fuzz_api_trees_parity(fphase):
+    """Random multi-site API trees (tombstones, history specials,
+    irregular causes) through both F backends — exact equality."""
+    rng = random.Random(0xF0F0)
+    for case in range(12):
+        sites = [new_site_id() for _ in range(3)]
+        base_vals = [str(i) for i in range(rng.randrange(1, 20))]
+        ra = c.clist(*base_vals)
+        rb = c.CausalList(ra.ct.evolve(site_id=sites[2]))
+        for _ in range(rng.randrange(0, 15)):
+            ra = ra.insert(rand_node(rng, ra, site_id=sites[0]))
+        for _ in range(rng.randrange(0, 15)):
+            rb = rb.insert(rand_node(rng, rb, site_id=sites[1]))
+        cap = 8 * ((max(len(ra.ct.nodes), len(rb.ct.nodes)) + 7) // 8)
+        cap = max(cap, 16)
+        row = _api_concat_row([ra, rb], cap)
+        v5row = benchgen.v5_inputs(row, cap)
+        u = max(8, benchgen.estimate_tokens(v5row) + 8)
+        args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+
+        def run():
+            return merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+
+        base, got = fphase(run)
+        assert_equal_outputs(base, got, f"case {case}")

@@ -210,3 +210,61 @@ def test_v5_beststream_combined_exports_for_tpu(monkeypatch):
         jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
     finally:
         batched_merge_weave_v5.clear_cache()
+
+
+def test_fphase_kernel_exports_for_tpu(monkeypatch):
+    """The fused F-phase expansion (pallas_fphase) must lower via
+    Mosaic: dynamic-start window loads from the transposed tables,
+    sublane-axis reductions, vector stores, and the vectorized
+    visibility pass with jnp.roll."""
+    from cause_tpu.weaver import pallas_fphase
+
+    monkeypatch.setattr(pallas_fphase, "_interpret", lambda: False)
+    rng = np.random.RandomState(5)
+    B, N, U, S = 12, 512, 160, 64  # B pads to 16; U/S pad to 128
+    lk = np.sort(np.stack([
+        rng.choice(N, size=U, replace=False) for _ in range(B)
+    ]), axis=1).astype(np.int32)
+    lk[:, 100:] = N  # sentinel tail
+    tb = rng.randint(0, N, size=(B, U)).astype(np.int32)
+    cs = np.full((B, S), N, np.int32)
+    ce = np.zeros((B, S), np.int32)
+    cs[:, :10] = np.arange(10, dtype=np.int32) * 40
+    ce[:, :10] = cs[:, :10] + 30
+    vc = rng.randint(0, 4, size=(B, N)).astype(np.int32)
+    seg = np.repeat(np.arange(N // 8, dtype=np.int32), 8)[None].repeat(
+        B, 0).astype(np.int32)
+    fl = rng.randint(0, 4, size=(B, N)).astype(np.int32)
+
+    def f(*a):
+        return jax.vmap(pallas_fphase.fphase_expand)(*a)
+
+    jax.export.export(jax.jit(f), platforms=["tpu"])(
+        *(jnp.asarray(x) for x in (lk, tb, cs, ce, vc, seg, fl)))
+
+
+def test_v5_fphase_exports_for_tpu(monkeypatch):
+    """The full v5 program under CAUSE_TPU_FPHASE=pallas lowers for
+    TPU — the exact program the harvest ladder times."""
+    monkeypatch.setenv("CAUSE_TPU_FPHASE", "pallas")
+    from cause_tpu.weaver import pallas_fphase
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+
+    monkeypatch.setattr(pallas_fphase, "_interpret", lambda: False)
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=120, n_div=40, capacity=256, hide_every=8
+    )
+    v5 = benchgen.batched_v5_inputs(batch, 256)
+    u = benchgen.v5_token_budget(v5)
+    args = [jnp.asarray(v5[k]) for k in LANE_KEYS5]
+
+    def f(*a):
+        return batched_merge_weave_v5(*a, u_max=u, k_max=u)
+
+    batched_merge_weave_v5.clear_cache()
+    try:
+        jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+    finally:
+        batched_merge_weave_v5.clear_cache()
