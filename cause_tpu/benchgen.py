@@ -181,7 +181,8 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
     ("v2" chain-compressed, "v3" sparse-irregular, "v4"
     marshal-resolved causes, "v4w" = v4 with the sequential Pallas
     euler walk, "v5" segment-union with token budget ``u_max``,
-    "v5w" = v5 with the Pallas euler walk) — with
+    "v5w" = v5 with the Pallas euler walk, "v5f" = v5 with the whole
+    token pipeline fused into Pallas kernels — jaxw5f) — with
     that run budget, returning a length-2 device array ``[checksum,
     n_overflowed_rows]`` (one transfer fetches both); ``k_max=0`` runs
     the uncompressed v1 kernel and returns just the checksum. v1-v3
@@ -215,18 +216,25 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
                 + jnp.sum(conflict.astype(jnp.float32))
             )
 
-        if k_max > 0 and kernel in ("v5", "v5w"):
-            from .weaver.jaxw5 import batched_merge_weave_v5
+        if k_max > 0 and kernel in ("v5", "v5w", "v5f"):
+            if kernel == "v5f":
+                from .weaver.jaxw5f import batched_merge_weave_v5f
 
-            _euler = "walk" if kernel == "v5w" else "doubling"
+                def batched(*a):
+                    return batched_merge_weave_v5f(
+                        *a, u_max=u_max, k_max=k_max)
+            else:
+                from .weaver.jaxw5 import batched_merge_weave_v5
+
+                _euler = "walk" if kernel == "v5w" else "doubling"
+
+                def batched(*a):
+                    return batched_merge_weave_v5(
+                        *a, u_max=u_max, k_max=k_max, euler=_euler)
 
             @jax.jit
             def program(*a):
-                rank, visible, conflict, overflow = (
-                    batched_merge_weave_v5(
-                        *a, u_max=u_max, k_max=k_max, euler=_euler
-                    )
-                )
+                rank, visible, conflict, overflow = batched(*a)
                 return jnp.stack([
                     jnp.sum(rank.astype(jnp.float32))
                     + jnp.sum(visible.astype(jnp.float32))

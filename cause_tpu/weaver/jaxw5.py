@@ -330,6 +330,20 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
         return (take1d(tb, owner_ss)
                 + jnp.where(ex, pc - take1d(sg_lane0, m), 0)).astype(jnp.int32)
 
+    if stage == "_AB":
+        # internal handoff for the fused v5f pipeline (jaxw5f): every
+        # phase-A/B product the token kernels consume, plus the
+        # coverage inputs the F glue needs. Not a profiling stage —
+        # returns a namespace of traced arrays, so only jaxw5f calls
+        # it (inside its own jit), never the jitted entry points.
+        from types import SimpleNamespace
+
+        return SimpleNamespace(
+            t_hi=t_hi, t_lo=t_lo, t_len=t_len, t_vc=t_vc,
+            t_tsp=t_tsp, t_lane=t_lane, token_of_lane=token_of_lane,
+            overflow_u=overflow_u, survive=survive, inv_s=inv_s,
+            uidx=uidx)
+
     # ================= C. sort tokens, dedupe =======================
     # With a network sort (bitonic/pallas) the payload fields RIDE the
     # sort — one roll+select per stage each, all streaming — instead of

@@ -1,0 +1,187 @@
+"""v5f: the v5 merge with its token pipeline fused into Pallas.
+
+Composition (per replica row; batch via ``vmap`` maps every kernel
+onto its 8-row grid):
+
+1. jaxw5 phases A+B in XLA (segment ordering, explode/dedupe, token
+   construction — S-width tables and the marshal-side gathers where
+   the ``CAUSE_TPU_*`` streaming strategies apply), via the
+   ``stage="_AB"`` handoff.
+2. The phase-D host walk, ALSO in XLA and hoisted BEFORE the token
+   sort: the walk only chases cause chains through special nodes, so
+   it is sort-independent — hoisting it keeps its data-dependent
+   N-table gathers on the XLA side where ``rowgather`` streams them,
+   and hands the kernels pure token-index links.
+3. ``pallas_befuse.k1_sort_redirect`` — token sort + dedupe +
+   kept-head redirection (VMEM bitonic networks).
+4. ``pallas_befuse.k2_runs`` — run extraction + contracted forest
+   (compaction sorts replace searchsorted gathers and scatters).
+5. ``pallas_ops.euler_walk`` — the sequential preorder automaton
+   (the v5w euler; bit-exact vs pointer doubling by the v5w parity
+   suite).
+6. ``pallas_befuse.k4_rank_kills`` — run-base expansion (window
+   trick), token kills, and the lane-sort handoff.
+7. ``pallas_fphase.fphase_expand`` — the F-phase tile-window
+   expansion to concat lanes (rank + visibility).
+
+Between kernels only [*, P]-token-width arrays round-trip HBM (~9 KB
+per row per operand — microseconds for the full batch); everything
+wider is VMEM-resident inside a kernel. The XLA remainder is phases
+A/B, the host walk, and the two kill scatters + coverage tables of
+the F glue.
+
+``BENCH_KERNEL=v5f`` selects this path in the benchmarks; exactness
+vs ``merge_weave_kernel_v5`` is pinned bit-for-bit on non-overflow
+rows by tests/test_befuse.py. Falls back to jaxw5 when the concat
+width is incompatible with the F kernel (N % 128 != 0 or N >= 2^24
+— the MXU flip exactness bound).
+
+Reference anchor: /root/reference/src/causal/collections/shared.cljc
+:225-241 (the weave linearization), at batch width.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .arrays import I32_MAX
+from .bitonic import sort_pairs
+from .gatherops import take1d
+from .jaxw5 import merge_weave_kernel_v5
+from .pallas_befuse import (k1_sort_redirect, k2_runs, k4_rank_kills,
+                            next_pow2)
+from .pallas_fphase import fphase_expand
+from .pallas_ops import euler_walk
+
+__all__ = ["merge_weave_kernel_v5f", "batched_merge_weave_v5f"]
+
+BIG = I32_MAX
+
+
+def merge_weave_kernel_v5f(hi, lo, cci, vclass, valid, seg,
+                           sg_min_hi, sg_min_lo, sg_max_hi,
+                           sg_max_lo, sg_len, sg_lane0, sg_dense,
+                           sg_tail_special, sg_valid, sg_vsum,
+                           u_max: int, k_max: int):
+    """Fused-token-pipeline v5 for one replica set; contract and
+    outputs identical to ``merge_weave_kernel_v5``."""
+    N = hi.shape[0]
+    if N % 128 != 0 or N >= (1 << 24):
+        return merge_weave_kernel_v5(
+            hi, lo, cci, vclass, valid, seg, sg_min_hi, sg_min_lo,
+            sg_max_hi, sg_max_lo, sg_len, sg_lane0, sg_dense,
+            sg_tail_special, sg_valid, sg_vsum,
+            u_max=u_max, k_max=k_max)
+
+    ab = merge_weave_kernel_v5(
+        hi, lo, cci, vclass, valid, seg, sg_min_hi, sg_min_lo,
+        sg_max_hi, sg_max_lo, sg_len, sg_lane0, sg_dense,
+        sg_tail_special, sg_valid, sg_vsum,
+        u_max=u_max, k_max=k_max, stage="_AB")
+
+    U = u_max
+    P = next_pow2(max(U, 128))
+    Kp = next_pow2(max(k_max, 128))
+
+    # ---- phase-D prep in XLA (presort; rides the gather switches) --
+    tva0 = ~((ab.t_hi == BIG) & (ab.t_lo == BIG))
+    cl0 = jnp.where(
+        tva0, take1d(cci, jnp.clip(ab.t_lane, 0, N - 1)), -1)
+    cu0m = jnp.where(cl0 >= 0, ab.token_of_lane(cl0), -1)
+
+    # host walk: chase cause chains through specials (sort-independent
+    # — each token's walk depends only on the lane tables, so it runs
+    # presort; jaxw5 runs the identical recurrence post-sort)
+    chase = tva0 & (ab.t_vc == 0)
+
+    def wcond(c):
+        p, i = c
+        pc = jnp.clip(p, 0, N - 1)
+        on = chase & (p >= 0) & (take1d(vclass, pc) > 0)
+        return (i < N) & jnp.any(on)
+
+    def wbody(c):
+        p, i = c
+        pc = jnp.clip(p, 0, N - 1)
+        on = chase & (p >= 0) & (take1d(vclass, pc) > 0)
+        return jnp.where(on, take1d(cci, pc), p), i + 1
+
+    host_lane, _ = lax.while_loop(wcond, wbody, (cl0, jnp.int32(0)))
+    hu0m = jnp.where(host_lane >= 0, ab.token_of_lane(host_lane), -1)
+
+    def pad_p(x, fill):
+        if P == U:
+            return x.astype(jnp.int32)
+        return jnp.concatenate(
+            [x.astype(jnp.int32), jnp.full((P - U,), fill, jnp.int32)]
+        )
+
+    # ---- the fused token pipeline ---------------------------------
+    (sv_len, sv_vc, sv_tsp, sv_lane, keep_i, cause_su, parent_su,
+     scal1) = k1_sort_redirect(
+        pad_p(ab.t_hi, BIG), pad_p(ab.t_lo, BIG), pad_p(ab.t_vc, 0),
+        pad_p(ab.t_len, 0), pad_p(ab.t_tsp, 0), pad_p(ab.t_lane, 0),
+        pad_p(cu0m, -1), pad_p(hu0m, -1), U=U)
+    conflict = scal1[0] != 0
+
+    (fc, ns, parent_up, run_w, hc, h_w, run_id, glued_i, prev_kept,
+     scal2) = k2_runs(sv_len, sv_vc, sv_tsp, keep_i, cause_su,
+                      parent_su, U=U, k_max=k_max, Kp=Kp)
+
+    base_run = euler_walk(fc, ns, parent_up, run_w, Kp)
+
+    lk, tb_l, vict_in, vict_tail, scal4 = k4_rank_kills(
+        base_run, hc, h_w, run_id, keep_i, sv_len, sv_vc, sv_lane,
+        glued_i, prev_kept, cause_su, scal2,
+        U=U, k_max=k_max, N=N)
+    root_val = scal4[0]
+    overflow_k = scal4[1] != 0
+
+    # ---- F glue (jaxw5's fused-F branch, verbatim semantics) -------
+    killed_sc = jnp.zeros(N + 1, bool)
+    killed_sc = killed_sc.at[vict_in].set(True, mode="drop")
+    killed_sc = killed_sc.at[vict_tail].set(True, mode="drop")
+    root_lane = jnp.zeros(N, bool).at[
+        jnp.clip(root_val, 0, N - 1)
+    ].set(root_val < N)
+    killed_ext = killed_sc[:N] | root_lane
+
+    seg_cov = sg_valid & take1d(ab.survive, ab.inv_s)
+    cov_start = jnp.where(seg_cov, sg_lane0, N).astype(jnp.int32)
+    cov_end = jnp.where(seg_cov, sg_lane0 + sg_len, 0).astype(
+        jnp.int32)
+    cs, ce = sort_pairs((cov_start, cov_end), num_keys=1)
+    flags = (valid.astype(jnp.int32)
+             | (killed_ext.astype(jnp.int32) << 1))
+    rank_lane, visible = fphase_expand(
+        lk, tb_l, cs, ce, vclass, seg, flags)
+
+    overflow = ab.overflow_u | overflow_k
+    return rank_lane, visible, conflict, overflow
+
+
+merge_weave_kernel_v5f_jit = jax.jit(
+    merge_weave_kernel_v5f, static_argnames=("u_max", "k_max"))
+
+
+@partial(jax.jit, static_argnames=("u_max", "k_max"))
+def batched_merge_weave_v5f(hi, lo, cci, vclass, valid, seg,
+                            sg_min_hi, sg_min_lo, sg_max_hi,
+                            sg_max_lo, sg_len, sg_lane0, sg_dense,
+                            sg_tail_special, sg_valid, sg_vsum,
+                            u_max: int, k_max: int):
+    """Batched v5f: [B, N] lanes + [B, S] segment tables ->
+    per-replica (rank, visible, conflict, overflow), like
+    ``batched_merge_weave_v5``."""
+
+    def row(*a):
+        return merge_weave_kernel_v5f(*a, u_max=u_max, k_max=k_max)
+
+    return jax.vmap(row)(hi, lo, cci, vclass, valid, seg,
+                         sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
+                         sg_len, sg_lane0, sg_dense, sg_tail_special,
+                         sg_valid, sg_vsum)

@@ -137,6 +137,33 @@ def main() -> None:
     for k in SWITCHES:
         os.environ.pop(k, None)
 
+    # ---- host marshal BEFORE the backend claim (round-5 window
+    # economy): ~60-90 s of pure numpy that must not spend granted
+    # tunnel time — the axon claim is in flight from interpreter
+    # start, so this overlaps the claim wait
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import (
+        LANE_KEYS4,
+        LANE_KEYS5,
+        enable_compile_cache,
+        merge_wave_scalar,
+    )
+    from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5
+
+    if a.smoke:
+        B, NB, ND, CAP = 8, 800, 100, 1024
+    else:
+        B, NB, ND, CAP = 1024, 9_000, 1_000, 10_240
+
+    t0 = time.monotonic()
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=NB, n_div=ND, capacity=CAP, hide_every=8
+    )
+    v5batch = benchgen.batched_v5_inputs(batch, CAP)
+    u_budget = benchgen.v5_token_budget(v5batch)
+    budget = benchgen.pair_run_budget(batch)
+    emit(ev="marshal", ms=round((time.monotonic() - t0) * 1000, 1))
+
     # Bounded backend claim (shared guard; see claimguard docstring):
     # hard-exit if the tunnel claim wedges past HARVEST_CLAIM_DEADLINE,
     # disarmed before any compile can be in flight.
@@ -147,15 +174,6 @@ def main() -> None:
 
     import jax
     import jax.numpy as jnp
-
-    from cause_tpu import benchgen
-    from cause_tpu.benchgen import (
-        LANE_KEYS4,
-        LANE_KEYS5,
-        enable_compile_cache,
-        merge_wave_scalar,
-    )
-    from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5
 
     if a.allow_cpu:
         jax.config.update("jax_platforms", "cpu")
@@ -178,18 +196,7 @@ def main() -> None:
     # done: the state file gates what a real full-size window measures
     record_state = plat != "cpu" and not a.smoke
 
-    if a.smoke:
-        B, NB, ND, CAP = 8, 800, 100, 1024
-    else:
-        B, NB, ND, CAP = 1024, 9_000, 1_000, 10_240
-
-    # ---- host marshal + one upload serving every full-size item ------
-    t0 = time.monotonic()
-    batch = benchgen.batched_pair_lanes(
-        n_replicas=B, n_base=NB, n_div=ND, capacity=CAP, hide_every=8
-    )
-    v5batch = benchgen.batched_v5_inputs(batch, CAP)
-    emit(ev="marshal", ms=round((time.monotonic() - t0) * 1000, 1))
+    # ---- one upload serving every full-size item --------------------
     t0 = time.monotonic()
     dev = {k: jax.device_put(batch[k])
            for k in dict.fromkeys(LANE_KEYS4)}
@@ -198,8 +205,6 @@ def main() -> None:
             dev[k] = jax.device_put(v5batch[k])
     for v in dev.values():
         v.block_until_ready()  # best effort; the sync below is real
-    u_budget = benchgen.v5_token_budget(v5batch)
-    budget = benchgen.pair_run_budget(batch)
     np.asarray(jnp.sum(dev["hi"][0, :8]))  # real sync: upload done
     emit(ev="upload", ms=round((time.monotonic() - t0) * 1000, 1),
          u_budget=int(u_budget), run_budget=int(budget))
@@ -238,6 +243,8 @@ def main() -> None:
                 vals.add(f"{k_}={v}")
         if kernel in ("v5w", "v4w"):
             vals.add("euler=walk")
+        if kernel == "v5f":
+            vals.add("kernel=v5f")
         return vals
 
     def suspect_gate(name, kernel, cfg) -> bool:
@@ -253,12 +260,12 @@ def main() -> None:
         return False
 
     def dispatch(kernel, k):
-        lanes = (LANE_KEYS5 if kernel in ("v5", "v5w")
+        lanes = (LANE_KEYS5 if kernel in ("v5", "v5w", "v5f")
                  else LANE_KEYS4)
         args = [dev[name] for name in lanes]
         return merge_wave_scalar(
             *args, k_max=k, kernel=kernel,
-            u_max=k if kernel in ("v5", "v5w") else 0,
+            u_max=k if kernel in ("v5", "v5w", "v5f") else 0,
         )
 
     def bench_item(name, kernel, cfg, burst_n=8, record=True):
@@ -267,7 +274,7 @@ def main() -> None:
         if suspect_gate(name, kernel, cfg):
             return
         set_config(cfg)
-        k = u_budget if kernel in ("v5", "v5w") else budget
+        k = u_budget if kernel in ("v5", "v5w", "v5f") else budget
         try:
             for _ in range(3):  # compile + warm + overflow ladder
                 out = np.asarray(dispatch(kernel, k))
@@ -338,13 +345,23 @@ def main() -> None:
 
         def digests(kernel, cfg):
             set_config(cfg)
-            euler = "walk" if kernel == "v5w" else "doubling"
+            if kernel == "v5f":
+                from cause_tpu.weaver.jaxw5f import (
+                    batched_merge_weave_v5f)
+
+                def run_kernel(*a):
+                    return batched_merge_weave_v5f(
+                        *a, u_max=k, k_max=k)
+            else:
+                euler = "walk" if kernel == "v5w" else "doubling"
+
+                def run_kernel(*a):
+                    return batched_merge_weave_v5(
+                        *a, u_max=k, k_max=k, euler=euler)
 
             @jax.jit
             def prog(*a):
-                rank, vis, conflict, ovf = batched_merge_weave_v5(
-                    *a, u_max=k, k_max=k, euler=euler
-                )
+                rank, vis, conflict, ovf = run_kernel(*a)
                 lane = jax.lax.broadcasted_iota(
                     jnp.uint32, rank.shape, 1)
                 x = (rank.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
@@ -381,11 +398,17 @@ def main() -> None:
                     save_state(done)
                 return
             # attribute the culprit: one switch (or the euler walk)
-            # at a time against the same baseline digests
+            # at a time against the same baseline digests. Snapshot
+            # the suspect set first — with two verify items in the
+            # ladder, suspects left by an earlier one must not
+            # suppress THIS item's combination-only fallback.
+            pre_suspects = set(suspect_values)
             singles = [("v5", dict(cfg_a, **{k_: v}), f"{k_}={v}")
                        for k_, v in cfg_b.items() if v != "xla"]
             if kernel_b in ("v5w", "v4w"):
                 singles.append(("v5w", dict(cfg_a), "euler=walk"))
+            if kernel_b == "v5f":
+                singles.append(("v5f", dict(cfg_a), "kernel=v5f"))
             for kern, cfg1, val in singles:
                 d1, ov1 = digests(kern, cfg1)
                 m1 = int(np.sum(da != d1))
@@ -394,7 +417,7 @@ def main() -> None:
                 emit(ev="verify_attr", item=name, strategy=val,
                      mismatch_rows=m1, overflow=int(ov1),
                      platform=plat)
-            if not suspect_values:
+            if not (suspect_values - pre_suspects):
                 # combination-only defect: no single strategy
                 # reproduces it, so every strategy in the failing
                 # config is suspect — better to skip them all than to
@@ -404,6 +427,8 @@ def main() -> None:
                     if v != "xla")
                 if kernel_b in ("v5w", "v4w"):
                     suspect_values.add("euler=walk")
+                if kernel_b == "v5f":
+                    suspect_values.add("kernel=v5f")
                 emit(ev="verify_attr", item=name,
                      strategy="combination-only",
                      note="no single culprit; all strategies of the "
@@ -571,6 +596,14 @@ def main() -> None:
          ("verify_beststream", XLA_BASE, "v5w", BESTSTREAM)),
         ("bench_beststream", bench_item,
          ("bench_beststream", "v5w", BESTSTREAM)),
+        # round-5 fused token pipeline: the new headline candidate,
+        # digest-gated like beststream, measured both ways
+        ("verify_v5f", verify_item,
+         ("verify_v5f", XLA_BASE, "v5f", BESTSTREAM)),
+        ("bench_v5f", bench_item,
+         ("bench_v5f", "v5f", BESTSTREAM)),
+        ("bench_v5f_xla", bench_item,
+         ("bench_v5f_xla", "v5f", XLA_BASE)),
         ("bench_xla_base", bench_item,
          ("bench_xla_base", "v5", XLA_BASE)),
         ("bench_psort", bench_item,
@@ -618,6 +651,7 @@ def main() -> None:
     attempted = done | skipped_suspect
     if suspect_values:
         attempted.add("verify_beststream")
+        attempted.add("verify_v5f")
     complete = all(
         name in attempted for name, _, _ in ladder
         if name not in ("bench_v5", "bench_v5_bookend")

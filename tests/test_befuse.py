@@ -1,0 +1,205 @@
+"""Bit-exactness of the fused token pipeline (v5f: jaxw5f +
+pallas_befuse + euler_walk + pallas_fphase) against jaxw5's XLA
+phases.
+
+jaxw5 is itself parity-pinned against v1 and the pure oracle
+(tests/test_jax_v5.py), so exact equality of all four outputs is the
+full correctness statement. Mosaic lowering of the three new kernels
+is guarded in tests/test_pallas_lowering.py."""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import cause_tpu as c
+from cause_tpu import benchgen
+from cause_tpu.benchgen import LANE_KEYS5
+from cause_tpu.ids import new_site_id
+from cause_tpu.weaver.jaxw5 import (batched_merge_weave_v5,
+                                    merge_weave_kernel_v5_jit)
+from cause_tpu.weaver.jaxw5f import (batched_merge_weave_v5f,
+                                     merge_weave_kernel_v5f_jit)
+
+from test_fphase import _api_concat_row
+from test_list import rand_node
+
+OUT_NAMES = ("rank", "visible", "conflict", "overflow")
+
+
+def assert_same(base, got, tag=""):
+    for b, g, name in zip(base, got, OUT_NAMES):
+        b, g = np.asarray(b), np.asarray(g)
+        assert np.array_equal(b, g), (
+            f"{tag} {name} diverged at "
+            f"{np.flatnonzero((b != g).ravel())[:8]}"
+        )
+
+
+@pytest.mark.parametrize(
+    "B,nb,nd,cap,he",
+    [
+        (3, 120, 40, 256, 8),   # odd B: pads to the 8-row block
+        (8, 120, 40, 192, 4),
+        (5, 60, 3, 64, 2),      # tiny N=128
+        (4, 0, 30, 64, 3),      # no shared base
+        (2, 30, 10, 64, 0),     # no tombstones
+        (6, 50, 40, 128, 2),    # tombstone-heavy
+    ],
+)
+def test_batched_parity(B, nb, nd, cap, he):
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=B, n_base=nb, n_div=nd, capacity=cap, hide_every=he
+    )
+    v5b = benchgen.batched_v5_inputs(batch, cap)
+    u = benchgen.v5_token_budget(v5b)
+    args = [jnp.asarray(v5b[k]) for k in LANE_KEYS5]
+    base = jax.jit(
+        lambda *a: batched_merge_weave_v5(*a, u_max=u, k_max=u)
+    )(*args)
+    got = jax.jit(
+        lambda *a: batched_merge_weave_v5f(*a, u_max=u, k_max=u)
+    )(*args)
+    assert not np.asarray(base[3]).any()
+    assert_same(base, got, f"B={B} cap={cap}")
+
+
+def test_separate_budgets():
+    """u_max != k_max exercises the K-space vs P-space split."""
+    row = benchgen.divergent_pair_lanes(
+        n_base=100, n_div=40, capacity=192, hide_every=5
+    )
+    v5row = benchgen.v5_inputs(row, 192)
+    u = benchgen.v5_token_budget(v5row)
+    args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+    base = merge_weave_kernel_v5_jit(*args, u_max=u + 40, k_max=u)
+    got = merge_weave_kernel_v5f_jit(*args, u_max=u + 40, k_max=u)
+    assert_same(base, got, "u!=k")
+
+
+def test_overflow_flag_parity():
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=100, n_div=60, capacity=192, hide_every=4
+    )
+    v5b = benchgen.batched_v5_inputs(batch, 192)
+    args = [jnp.asarray(v5b[k]) for k in LANE_KEYS5]
+    base = jax.jit(
+        lambda *a: batched_merge_weave_v5(*a, u_max=16, k_max=16)
+    )(*args)
+    got = jax.jit(
+        lambda *a: batched_merge_weave_v5f(*a, u_max=16, k_max=16)
+    )(*args)
+    assert np.asarray(base[3]).any()
+    assert np.array_equal(np.asarray(base[3]), np.asarray(got[3]))
+
+
+def test_non_multiple_of_128_falls_back():
+    row = benchgen.divergent_pair_lanes(
+        n_base=30, n_div=10, capacity=72, hide_every=3  # N = 144
+    )
+    v5row = benchgen.v5_inputs(row, 72)
+    u = benchgen.v5_token_budget(v5row)
+    args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+    base = merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+    got = merge_weave_kernel_v5f_jit(*args, u_max=u, k_max=u)
+    assert_same(base, got, "fallback")
+
+
+def test_fuzz_api_trees_parity():
+    """Random multi-site API trees (tombstones, history specials,
+    irregular causes) through both pipelines — exact equality.
+
+    All cases share ONE (capacity, budget) bucket: every distinct
+    shape compiles another multi-thousand-op unrolled-network program,
+    and ten of them in one process exhausts LLVM's memory maps."""
+    rng = random.Random(0xBEEF)
+    cap, u = 64, 128
+    for case in range(10):
+        sites = [new_site_id() for _ in range(3)]
+        base_vals = [str(i) for i in range(rng.randrange(1, 20))]
+        ra = c.clist(*base_vals)
+        rb = c.CausalList(ra.ct.evolve(site_id=sites[2]))
+        for _ in range(rng.randrange(0, 15)):
+            ra = ra.insert(rand_node(rng, ra, site_id=sites[0]))
+        for _ in range(rng.randrange(0, 15)):
+            rb = rb.insert(rand_node(rng, rb, site_id=sites[1]))
+        assert max(len(ra.ct.nodes), len(rb.ct.nodes)) <= cap
+        row = _api_concat_row([ra, rb], cap)
+        v5row = benchgen.v5_inputs(row, cap, s_max=cap)
+        args = [jnp.asarray(v5row[k]) for k in LANE_KEYS5]
+        base = merge_weave_kernel_v5_jit(*args, u_max=u, k_max=u)
+        got = merge_weave_kernel_v5f_jit(*args, u_max=u, k_max=u)
+        assert_same(base, got, f"case {case}")
+
+
+class TestBuildingBlocks:
+    """The Mosaic-path helpers vs their reference ops. Interpret-mode
+    runs of the composed kernels use the references directly (LLVM
+    memory-map limits), so these pin the network forms the TPU
+    actually executes — forced via the _interpret monkeypatch, run as
+    plain XLA ops outside any kernel."""
+
+    @pytest.fixture(autouse=True)
+    def force_network(self, monkeypatch):
+        from cause_tpu.weaver import pallas_befuse as bf
+
+        monkeypatch.setattr(bf, "_interpret", lambda: False)
+        self.bf = bf
+
+    def test_bitonic_matches_stable_sort(self):
+        bf = self.bf
+        rng = np.random.RandomState(3)
+        for P in (128, 256, 512):
+            ops = tuple(
+                jnp.asarray(rng.randint(0, 9, size=(1, P)),
+                            dtype=jnp.int32)
+                for _ in range(4))
+            for nk in (1, 2):
+                want = jax.lax.sort(ops, num_keys=nk, is_stable=True,
+                                    dimension=1)
+                got = bf._bitonic_vals(ops, num_keys=nk)
+                for w, g in zip(want, got):
+                    assert np.array_equal(np.asarray(w),
+                                          np.asarray(g)), (P, nk)
+
+    def test_cumsum_cummax_match(self):
+        bf = self.bf
+        rng = np.random.RandomState(4)
+        x = jnp.asarray(rng.randint(-50, 50, size=(1, 512)),
+                        dtype=jnp.int32)
+        assert np.array_equal(
+            np.asarray(bf._cumsum(x)),
+            np.asarray(jnp.cumsum(x, axis=1, dtype=jnp.int32)))
+        assert np.array_equal(
+            np.asarray(bf._cummax(x)),
+            np.asarray(jax.lax.cummax(x, axis=1)))
+
+    def test_gather_and_flips_match(self):
+        bf = self.bf
+        rng = np.random.RandomState(5)
+        eye = bf._eye_f32()
+        W, Q = 384, 256
+        t1 = jnp.asarray(rng.randint(-1, 2 ** 20, size=(1, W)),
+                         dtype=jnp.int32)
+        t2 = jnp.asarray(rng.randint(-1, 128, size=(1, W)),
+                         dtype=jnp.int32)
+        idx = jnp.asarray(rng.randint(0, W, size=(1, Q)),
+                          dtype=jnp.int32)
+        g1, g2 = bf._gather(eye, [t1, t2], idx)
+        assert np.array_equal(
+            np.asarray(g1),
+            np.asarray(jnp.take_along_axis(t1, idx, axis=1)))
+        assert np.array_equal(
+            np.asarray(g2),
+            np.asarray(jnp.take_along_axis(t2, idx, axis=1)))
+        v = jnp.asarray(rng.randint(-1, 2 ** 22, size=(1, 128)),
+                        dtype=jnp.int32)
+        fl = bf._flip(eye, v)
+        assert fl.shape == (128, 1)
+        assert np.array_equal(np.asarray(fl).ravel(),
+                              np.asarray(v).ravel())
+        assert np.array_equal(
+            np.asarray(bf._unflip(eye, fl)), np.asarray(v))

@@ -268,3 +268,36 @@ def test_v5_fphase_exports_for_tpu(monkeypatch):
         jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
     finally:
         batched_merge_weave_v5.clear_cache()
+
+
+def test_v5f_pipeline_exports_for_tpu(monkeypatch):
+    """The full fused-token-pipeline program (jaxw5f: K1 + K2 +
+    euler_walk + K4 + fphase plus the XLA glue) must lower via Mosaic
+    — the exact program BENCH_KERNEL=v5f dispatches. Covers the
+    in-kernel bitonic networks, MXU identity flips, one-hot chunk
+    gathers, roll-based cumulative ops, window expansion, and the
+    fori row loops with pl.ds I/O in all three new kernels."""
+    from cause_tpu.weaver import pallas_befuse, pallas_fphase
+    from cause_tpu.weaver import pallas_ops as pops
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5f import batched_merge_weave_v5f
+
+    monkeypatch.setattr(pallas_befuse, "_interpret", lambda: False)
+    monkeypatch.setattr(pallas_fphase, "_interpret", lambda: False)
+    monkeypatch.setattr(pops, "_interpret", lambda: False)
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=120, n_div=40, capacity=256, hide_every=8
+    )
+    v5 = benchgen.batched_v5_inputs(batch, 256)
+    u = benchgen.v5_token_budget(v5)
+    args = [jnp.asarray(v5[k]) for k in LANE_KEYS5]
+
+    def f(*a):
+        return batched_merge_weave_v5f(*a, u_max=u, k_max=u)
+
+    batched_merge_weave_v5f.clear_cache()
+    try:
+        jax.export.export(jax.jit(f), platforms=["tpu"])(*args)
+    finally:
+        batched_merge_weave_v5f.clear_cache()
