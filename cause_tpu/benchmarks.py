@@ -300,12 +300,67 @@ def config5_batched_merge(weaver: str = "jax", n_replicas: int = 64,
     }
 
 
+def config6_map_fleet(weaver: str = "jax", n_pairs: int = 64,
+                      n_keys: int = 24, edits: int = 12,
+                      reps: int = 3, kernel: str = "v5",
+                      profile_dir: Optional[str] = None) -> dict:
+    import jax
+
+    """Map-fleet wave: batched merge of CausalMap replica pairs as
+    key-rooted forests (round-5 line: the v5 segment-union route makes
+    map fleets pay divergence, not node width — ``kernel="v4"``
+    measures the full-width route for comparison)."""
+    import random as _random
+
+    import cause_tpu as _c
+    from .collections.cmap import CausalMap
+    from .ids import new_site_id
+    from .weaver import mapw
+
+    rng = _random.Random(1234)
+    base = _c.cmap()
+    for i in range(n_keys):
+        base = base.append(_c.K(f"k{i}"), f"v{i}")
+    pairs = []
+    for p in range(n_pairs):
+        a = CausalMap(base.ct.evolve(site_id=new_site_id()))
+        b = CausalMap(base.ct.evolve(site_id=new_site_id()))
+        for e in range(edits):
+            a = a.append(_c.K(f"k{rng.randrange(n_keys + 4)}"),
+                         f"a{p}.{e}")
+            b = b.append(_c.K(f"k{rng.randrange(n_keys + 4)}"),
+                         f"b{p}.{e}")
+        pairs.append((a, b))
+
+    def step():
+        return mapw.merge_map_wave(pairs, kernel=kernel)
+
+    step()  # compile + warm
+    ctx = (
+        jax.profiler.trace(profile_dir)
+        if profile_dir
+        else contextlib.nullcontext()
+    )
+    with ctx:
+        secs, res = _timed(step, reps)
+    assert not res.fallback
+    return {
+        "config": 6,
+        "metric": f"map fleet wave, {n_pairs} pairs x "
+                  f"~{1 + n_keys + n_keys + edits} nodes",
+        "weaver": f"jax-{kernel}",
+        "value": round(secs * 1000.0, 3),
+        "unit": "ms",
+    }
+
+
 CONFIGS: Dict[int, Callable] = {
     1: config1_append_only,
     2: config2_concurrent_hide,
     3: config3_map_undo_redo,
     4: config4_rich_text_base,
     5: config5_batched_merge,
+    6: config6_map_fleet,
 }
 
 # configs 1-4 exercise the host path; 5 is device-only
@@ -314,7 +369,7 @@ HOST_WEAVERS = ("pure", "native")
 
 def run_config(num: int, weaver: str, profile_dir: Optional[str] = None) -> dict:
     fn = CONFIGS[num]
-    if num == 5:
+    if num in (5, 6):
         return fn(profile_dir=profile_dir)
     return fn(weaver)
 
@@ -335,6 +390,13 @@ def main(argv=None) -> None:
     for num in nums:
         if num == 5:
             print(json.dumps(run_config(num, "jax", args.profile)))
+            continue
+        if num == 6:
+            # map fleet: the v5 segment-union route and the v4
+            # full-width route, side by side
+            print(json.dumps(config6_map_fleet(
+                kernel="v5", profile_dir=args.profile)))
+            print(json.dumps(config6_map_fleet(kernel="v4")))
             continue
         weavers = [args.weaver] if args.weaver else list(HOST_WEAVERS)
         for w in weavers:

@@ -52,7 +52,10 @@ __all__ = [
     "forest_lanes",
     "pair_rows",
     "batched_merge_map_weave",
+    "batched_merge_map_weave_v5",
+    "map_v5_inputs",
     "sharded_merge_map_weave",
+    "sharded_merge_map_weave_v5",
     "merged_map_weave",
     "map_row_digest",
     "MapWaveResult",
@@ -232,6 +235,59 @@ def batched_merge_map_weave(lanes: Dict[str, np.ndarray], k_max: int = 0):
     )
 
 
+def map_v5_inputs(lanes: Dict[str, np.ndarray], cap: int):
+    """Segment-union (v5) inputs for forest-lane rows: the SAME
+    marshal the list path uses (benchgen.batched_v5_inputs — segment
+    extraction is id-layout-agnostic; synthetic key-root ids sort
+    below every real id, so per-tree lanes stay ascending and the
+    shared key roots dedupe as single-lane twins exactly like shared
+    base segments). Returns ``(v5lanes, u_budget)``."""
+    from .. import benchgen
+
+    v5b = benchgen.batched_v5_inputs(lanes, cap)
+    return v5b, benchgen.v5_token_budget(v5b)
+
+
+def batched_merge_map_weave_v5(lanes: Dict[str, np.ndarray], cap: int,
+                               u_max: int = 0, v5b=None):
+    """The v5 segment-union route for map forests (round-5: map
+    fleets no longer pay full node width for the union — merge cost
+    scales with divergence, like list fleets). Returns ``(rank,
+    visible, conflict, overflow)`` in CONCAT-LANE coordinates (the v5
+    contract: no order array) plus the effective token budget.
+    ``v5b``: pre-marshalled segment lanes (``map_v5_inputs``) so an
+    overflow retry does not redo the host-side segment extraction."""
+    from .. import benchgen
+    from .jaxw5 import batched_merge_weave_v5
+
+    if v5b is None:
+        v5b, est = map_v5_inputs(lanes, cap)
+        if u_max <= 0:
+            u_max = est
+    elif u_max <= 0:
+        from .. import benchgen as _b
+
+        u_max = _b.v5_token_budget(v5b)
+    out = batched_merge_weave_v5(
+        *(jnp.asarray(v5b[k]) for k in benchgen.LANE_KEYS5),
+        u_max=u_max, k_max=u_max,
+    )
+    return out, u_max
+
+
+def sharded_merge_map_weave_v5(mesh, lanes: Dict[str, np.ndarray],
+                               cap: int, u_max: int = 0):
+    """Sharded twin of the v5 map route: forest v5 lanes ride
+    ``parallel.mesh.sharded_merge_weave_v5`` unchanged (replica axis
+    over the mesh, digests psum'd fleet-wide)."""
+    from ..parallel.mesh import sharded_merge_weave_v5
+
+    v5b, est = map_v5_inputs(lanes, cap)
+    if u_max <= 0:
+        u_max = est
+    return sharded_merge_weave_v5(mesh, v5b, u_max, u_max), u_max
+
+
 def sharded_merge_map_weave(mesh, lanes: Dict[str, np.ndarray],
                             k_max: int = 0):
     """The sharded twin: map forests ride the v4 sharded step
@@ -252,18 +308,23 @@ def merged_map_weave(lanes, meta, order, rank, row: int):
     """Rebuild pair ``row``'s merged per-key weave dict from the
     kernel's order — the map twin of the list paths' rank argsort.
     Key subtrees are contiguous in Euler order; each key's segment
-    starts at its key-root lane."""
+    starts at its key-root lane.
+
+    ``order`` is the v4 sorted-lane permutation; ``None`` means the
+    v5 contract — ``rank`` is already indexed by concat lane."""
     from ..ids import ROOT_ID, ROOT_NODE
 
     cap = meta["capacity"]
-    order_r = np.asarray(order[row])
     rank_r = np.asarray(rank[row])
     N = 2 * cap
     # presort-lane visit order: sorted positions ordered by rank
     kept = rank_r < N
     pos = np.flatnonzero(kept)
     pos = pos[np.argsort(rank_r[pos], kind="stable")]
-    lanes_in_order = order_r[pos]
+    if order is None:
+        lanes_in_order = pos
+    else:
+        lanes_in_order = np.asarray(order[row])[pos]
     (nodes_a, keys_a), (nodes_b, keys_b) = meta["rows"][row]
 
     weave: Dict[object, list] = {}
@@ -291,10 +352,18 @@ def map_row_digest(lanes, order, rank, visible):
     the sharded path's device digest (parallel.mesh._fleet_stats):
     the v4 kernel reports rank/visible per SORTED lane, so the id
     lanes are re-sorted by ``order`` before the avalanche mix (pinned
-    by tests/test_mapw.py against the sharded output)."""
-    order = np.asarray(order).astype(np.int64)
-    hi = np.take_along_axis(lanes["hi"], order, axis=1).astype(np.uint32)
-    lo = np.take_along_axis(lanes["lo"], order, axis=1).astype(np.uint32)
+    by tests/test_mapw.py against the sharded output). ``order=None``
+    is the v5 contract — rank/visible already index concat lanes, and
+    the mix is lane-order-invariant."""
+    if order is None:
+        hi = np.asarray(lanes["hi"]).astype(np.uint32)
+        lo = np.asarray(lanes["lo"]).astype(np.uint32)
+    else:
+        order = np.asarray(order).astype(np.int64)
+        hi = np.take_along_axis(
+            lanes["hi"], order, axis=1).astype(np.uint32)
+        lo = np.take_along_axis(
+            lanes["lo"], order, axis=1).astype(np.uint32)
     rank = np.asarray(rank).astype(np.int64)
     m = rank.shape[1]
     keptm = rank < m
@@ -364,14 +433,20 @@ class MapWaveResult:
         return type(a)(ct)
 
 
-def merge_map_wave(pairs) -> MapWaveResult:
+def merge_map_wave(pairs, kernel: str = "v5") -> MapWaveResult:
     """Converge many CausalMap replica pairs in one batched device
     dispatch — the map twin of ``parallel.merge_wave`` (map trees
     cannot ride the list-lane wave; their forest encoding lives here).
     Pairs outside the forest domain (exotic id-cause chains, weft
     gibberish, PackSpec overflow) fall back to the per-pair host merge
     exactly like the list wave's fallback. Body validation between
-    duplicate ids is host-side in ``merged``, same contract."""
+    duplicate ids is host-side in ``merged``, same contract.
+
+    ``kernel``: "v5" (default since round 5 — the segment-union
+    route: the shared parts of a map fleet union at segment
+    granularity, so the union cost scales with divergence instead of
+    full node width, matching the list fleets) or "v4" (the original
+    full-width forest route)."""
     from ..collections import shared as s
 
     pairs = list(pairs)
@@ -436,24 +511,50 @@ def merge_map_wave(pairs) -> MapWaveResult:
         meta_rows.append(rm)
     meta = {"rows": meta_rows, "capacity": cap, "key_rank": krank}
 
-    order, rank, visible, _conflict, overflow = batched_merge_map_weave(
-        lanes
-    )
-    if bool(np.asarray(overflow).any()):  # pragma: no cover - k_max=N
-        raise s.CausalError("map wave overflowed its run budget",
-                            {"causes": {"token-overflow"}})
-    order = np.asarray(order)
+    if kernel == "v4":
+        order, rank, visible, _conflict, overflow = (
+            batched_merge_map_weave(lanes))
+        if bool(np.asarray(overflow).any()):  # pragma: no cover
+            raise s.CausalError("map wave overflowed its run budget",
+                                {"causes": {"token-overflow"}})
+        order = np.asarray(order)
+        row_ovf = np.zeros(len(live), bool)
+    elif kernel == "v5":
+        # segment-union route; the overflow flag backstops the sampled
+        # token estimate — double and re-dispatch (the segment marshal
+        # is done once, only the device program re-runs), and rows
+        # that STILL overflow fall back to the host merge per row
+        v5b, u = map_v5_inputs(lanes, cap)
+        for _ in range(3):
+            (rank, visible, _conflict, overflow), u = (
+                batched_merge_map_weave_v5(lanes, cap, u_max=u,
+                                           v5b=v5b))
+            row_ovf = np.asarray(overflow).astype(bool)
+            if not row_ovf.any():
+                break
+            u *= 2
+        order = None
+    else:
+        raise ValueError(
+            f"merge_map_wave kernel must be 'v5' or 'v4', got "
+            f"{kernel!r}")
     rank = np.asarray(rank)
     visible = np.asarray(visible)
     live_digest = map_row_digest(lanes, order, rank, visible)
 
-    # expand live rows back to the full index space
-    full_order = np.zeros((B, N), np.int32)
+    # expand live rows back to the full index space; overflowed v5
+    # rows carry garbage ranks — they join the host-merge fallback
+    full_order = None if order is None else np.zeros((B, N), np.int32)
     full_rank = np.full((B, N), N, np.int32)
     full_vis = np.zeros((B, N), bool)
     full_meta = [None] * B
     for j, i in enumerate(live):
-        full_order[i] = order[j]
+        if row_ovf[j]:
+            a, b = pairs[i]
+            fallback[i] = a.merge(b)
+            continue
+        if order is not None:
+            full_order[i] = order[j]
         full_rank[i] = rank[j]
         full_vis[i] = visible[j]
         full_meta[i] = meta_rows[j]
