@@ -213,6 +213,8 @@ from .collections.shared import causal_to_edn  # noqa: E402
 # Serialization: tagged JSON round-trip + bag-of-nodes reconstitution
 # (the reference's print/reader + refresh-caches checkpoint story).
 from .serde import dumps, loads  # noqa: E402
+from .gc import (compact, compact_stats,  # noqa: E402
+                 stability_frontier)
 from .sync import (  # noqa: E402
     sync_base_pair,
     sync_pair,
@@ -286,6 +288,9 @@ __all__ = [
     "merge",
     "merge_all",
     "blame",
+    "compact",
+    "compact_stats",
+    "stability_frontier",
     "content_digest",
     "get_weave",
     "get_nodes",

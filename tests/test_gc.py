@@ -1,0 +1,219 @@
+"""Tombstone/weave GC (cause_tpu.gc): semantics preserved, the right
+shapes reclaim, and the compacted tree stays a first-class citizen
+(serde, merge, device weaver, sync full-bag fallback).
+
+The reference only roadmaps this capability (reference
+README.md:254); the compaction rules and their limits are documented
+in cause_tpu/gc.py."""
+
+import random
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import K, serde
+from cause_tpu.gc import compact, compact_stats
+from cause_tpu.ids import ROOT_ID
+
+from test_list import rand_node
+
+
+def hide_tail(cl, n):
+    for _ in range(n):
+        tail = [nd for nd in list(cl)][-1]
+        cl = cl.append(tail[0], c.hide)
+    return cl
+
+
+def test_noop_when_nothing_hidden():
+    cl = c.clist(*"abc")
+    assert compact(cl) is cl
+
+
+def test_tail_delete_reclaims_and_preserves_edn():
+    cl = hide_tail(c.clist(*[str(i) for i in range(40)]), 15)
+    out = compact(cl)
+    st = compact_stats(cl, out)
+    assert c.causal_to_edn(out) == c.causal_to_edn(cl)
+    assert st["dropped"] >= 30  # 15 victims + 15 hide markers
+    assert ROOT_ID in out.ct.nodes
+    # idempotent
+    assert compact(out) is out
+
+
+def test_interior_tombstones_stay_as_skeleton():
+    """Interior deletions keep their cause-chain skeleton (the RGA
+    reality): visible text typed after a deletion depends on it."""
+    cl = c.clist(*[str(i) for i in range(20)])
+    ids = [nd[0] for nd in list(cl)]
+    cl = cl.append(ids[5], c.hide)  # interior victim
+    out = compact(cl)
+    assert c.causal_to_edn(out) == c.causal_to_edn(cl)
+    # victim + marker both survive (descendants chain through them)
+    assert ids[5] in out.ct.nodes
+
+
+def test_undone_branch_reclaims():
+    cl = c.clist(*"abcdef")
+    ids = [nd[0] for nd in list(cl)]
+    na = (100, "siteZZZZZZZZZ", 0)
+    nb = (101, "siteZZZZZZZZZ", 0)
+    cl = cl.insert((na, ids[3], "X")).insert((nb, na, "Y"))
+    cl = cl.append(nb, c.hide).append(na, c.hide)
+    out = compact(cl)
+    assert c.causal_to_edn(out) == c.causal_to_edn(cl)
+    assert compact_stats(cl, out)["dropped"] >= 4
+    assert na not in out.ct.nodes
+
+
+def test_map_lww_churn_reclaims_wholesale():
+    cm = c.cmap()
+    for j in range(6):
+        for o in range(10):
+            cm = cm.assoc(K(f"k{j}"), f"v{o}")
+    cm = cm.dissoc(K("k0"))
+    out = compact(cm)
+    st = compact_stats(cm, out)
+    assert c.causal_to_edn(out) == c.causal_to_edn(cm)
+    assert st["nodes_after"] <= 8  # ~one winner per surviving key
+    # undo-by-id on the surviving winner still works
+    k1_node = out.ct.weave[K("k1")][1]
+    out2 = out.append(k1_node[0], c.hide)
+    assert K("k1") not in c.causal_to_edn(out2)
+
+
+def test_compacted_tree_is_first_class():
+    """serde round-trip, cross-weaver merge, and new edits on a
+    compacted list."""
+    cl = hide_tail(c.clist(*[str(i) for i in range(30)]), 10)
+    out = compact(cl)
+    d = serde.to_data(out)
+    back = serde.from_data(d)
+    assert c.causal_to_edn(back) == c.causal_to_edn(out)
+    d["weaver"] = "jax"
+    jr = serde.from_data(d)
+    pid = [nd[0] for nd in list(out)][5]
+    m1 = c.insert(out, c.node(9000, "siteYYYYYYYYY", pid, "Z"))
+    m2 = c.insert(jr, c.node(9001, "siteXXXXXXXXX", pid, "W"))
+    assert c.causal_to_edn(c.merge(m1, m2)) == c.causal_to_edn(
+        c.merge(m2, m1))
+    assert c.causal_to_edn(out.conj("new"))[-1] == "new"
+
+
+def test_merge_into_peer_is_plain_idempotent_merge():
+    """compacted ⊆ old self: merging it into any peer that has the
+    full history is a no-op-ish ordinary merge."""
+    cl = hide_tail(c.clist(*[str(i) for i in range(25)]), 8)
+    peer = c.CausalList(cl.ct)  # full-history peer
+    out = compact(cl)
+    merged = peer.merge(out)
+    assert c.causal_to_edn(merged) == c.causal_to_edn(peer)
+
+
+def test_sync_full_bag_fallback_reimports_dropped_region():
+    """A peer whose delta references a dropped cause triggers the
+    sync layer's full-bag fallback and both sides converge."""
+    from cause_tpu import sync
+
+    cl = c.clist(*[str(i) for i in range(20)])
+    peer = c.CausalList(cl.ct.evolve(site_id="sitePPPPPPPPP"))
+    # peer keeps editing AFTER the region we will drop: cause its new
+    # node on the current tail (which compaction will drop)
+    tail = [nd for nd in list(peer)][-1]
+    peer = peer.insert(((50, "sitePPPPPPPPP", 0), tail[0], "P"))
+    # we delete the tail then compact it away
+    ours = hide_tail(cl, 5)
+    ours = compact(ours)
+    st_nodes = set(ours.ct.nodes)
+    assert tail[0] not in st_nodes  # the peer's cause is gone here
+    a, b = sync.sync_pair(ours, peer)
+    assert c.causal_to_edn(a) == c.causal_to_edn(b)
+    assert "P" in c.causal_to_edn(a)
+
+
+def test_fuzz_compaction_preserves_semantics():
+    """Random multi-site churn + hides: compact never changes the
+    rendered document, and compact(compact(x)) is stable."""
+    rng = random.Random(0x6C)
+    for case in range(15):
+        cl = c.clist(*[str(i) for i in range(rng.randrange(1, 15))])
+        sites = ["siteAAAAAAAAA", "siteBBBBBBBBB"]
+        for _ in range(rng.randrange(5, 30)):
+            cl = cl.insert(rand_node(rng, cl,
+                                     site_id=rng.choice(sites)))
+        before = c.causal_to_edn(cl)
+        out = compact(cl)
+        assert c.causal_to_edn(out) == before, case
+        again = compact(out)
+        assert c.causal_to_edn(again) == before, case
+        assert len(again.ct.nodes) == len(out.ct.nodes), case
+
+
+def test_base_collections_rejected_with_guidance():
+    cb = c.base()
+    with pytest.raises(c.CausalError):
+        compact(cb)
+
+
+def test_stability_frontier_math():
+    from cause_tpu.gc import stability_frontier
+
+    a = {"s1": [10, 0], "s2": [5, 2]}
+    b = {"s1": [7, 1], "s2": [5, 9], "s3": [2, 0]}
+    f = stability_frontier(a, b)
+    # lexicographic (ts, tx) minimum; s3 absent from a => unstable
+    assert f == {"s1": [7, 1], "s2": [5, 2]}
+    assert stability_frontier() == {}
+
+
+def test_frontier_prevents_tombstone_resurrection():
+    """The classic unsafe shape: peer A holds victim D but not B's
+    hide marker. Without a frontier, compaction drops D+marker and a
+    later merge from A resurrects D visibly (the cause survives, so
+    no fallback fires). With the frontier derived from A's version
+    vector, the deletion survives compaction and the merge converges
+    hidden."""
+    from cause_tpu import sync
+    from cause_tpu.gc import stability_frontier
+
+    base = c.clist(*"abc")
+    site_a, site_b = "siteAAAAAAAAA", "siteBBBBBBBBB"
+    head = [nd[0] for nd in list(base)][-1]
+    # A appends D at the tail
+    d_id = (10, site_a, 0)
+    a_rep = c.CausalList(base.ct.evolve(site_id=site_a)).insert(
+        (d_id, head, "D"))
+    # B (who has seen D) hides it; C = fully merged replica
+    b_rep = c.CausalList(a_rep.ct.evolve(site_id=site_b)).append(
+        d_id, c.hide)
+    c_rep = c.CausalList(b_rep.ct)
+    assert "D" not in c.causal_to_edn(c_rep)
+
+    # peer A never saw the hide marker: its vv lacks site_b entirely
+    vv_a = sync.version_vector(a_rep)
+    frontier = stability_frontier(vv_a, sync.version_vector(c_rep))
+
+    # UNSAFE form (quiesce asserted, falsely): deletion gets dropped
+    dropped = compact(c_rep)
+    assert d_id not in dropped.ct.nodes
+    resurrected = dropped.merge(a_rep)
+    assert "D" in c.causal_to_edn(resurrected)  # the documented hazard
+
+    # SAFE form: the frontier exempts B's unacked marker (and D)
+    safe = compact(c_rep, stable_vv=frontier)
+    assert "D" not in c.causal_to_edn(safe.merge(a_rep))
+    assert c.causal_to_edn(safe) == c.causal_to_edn(c_rep)
+
+
+def test_frontier_still_reclaims_stable_regions():
+    """Deletions below the frontier (acked fleet-wide) still drop."""
+    from cause_tpu import sync
+    from cause_tpu.gc import stability_frontier
+
+    cl = hide_tail(c.clist(*[str(i) for i in range(30)]), 10)
+    # every peer has everything: frontier == own vv
+    f = stability_frontier(sync.version_vector(cl),
+                           sync.version_vector(cl))
+    out = compact(cl, stable_vv=f)
+    assert compact_stats(cl, out)["dropped"] >= 20
+    assert c.causal_to_edn(out) == c.causal_to_edn(cl)
