@@ -212,18 +212,31 @@ def sharded_merge_weave_v4(mesh: Mesh, hi, lo, cci, vclass, valid,
 
 
 @lru_cache(maxsize=8)
-def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int):
+def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int,
+                     pipeline: str = "v5"):
     """The v5 (segment-union) sharded step: node lanes + segment
     tables in, per-replica (rank, visible, digest) + fleet stats out.
     v5 reports in concat-lane coordinates and produces no ``order``;
     the digest's mix-sum is permutation-invariant, so feeding the raw
     lanes with concat-coordinate ranks yields the same digest value as
-    the sorted-lane kernels."""
-    from ..weaver.jaxw5 import merge_weave_kernel_v5
+    the sorted-lane kernels. ``pipeline`` picks the row kernel: "v5"
+    (jaxw5) or "v5f" (the fused token pipeline, jaxw5f)."""
+    if pipeline == "v5f":
+        from ..weaver.jaxw5f import (
+            merge_weave_kernel_v5f as _row_kernel)
+
+        def merge_weave_kernel_v5(*r, u_max, k_max):
+            return _row_kernel(*r, u_max=u_max, k_max=k_max)
+    else:
+        from ..weaver.jaxw5 import merge_weave_kernel_v5
 
     axis = mesh.axis_names[0]
     sharded = P(axis)
     replicated = P()
+    # pallas_call inside shard_map cannot express varying-mesh-axes
+    # metadata on its outputs; the fused pipeline disables the vma
+    # check (outputs are per-row, trivially sharded like the inputs)
+    extra = {"check_vma": False} if pipeline == "v5f" else {}
 
     @partial(
         _shard_map,
@@ -231,6 +244,7 @@ def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int):
         in_specs=(sharded,) * 16,
         out_specs=(sharded, sharded, sharded, sharded, replicated,
                    replicated, replicated),
+        **extra,
     )
     def step(hi, lo, cci, vc, va, seg, *sg):
         rank, visible, conflict, overflow = jax.vmap(
@@ -249,7 +263,7 @@ def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int):
 
 
 def sharded_merge_weave_v5(mesh: Mesh, lanes: dict, u_max: int,
-                           k_max: int):
+                           k_max: int, pipeline: str = "v5"):
     """Shard the v5 segment-union merge over the mesh. ``lanes`` is the
     ``benchgen.LANE_KEYS5`` dict of [B, ...] arrays. Returns
     ``(rank, visible, overflow, digest, total_visible, n_conflicts,
@@ -266,5 +280,5 @@ def sharded_merge_weave_v5(mesh: Mesh, lanes: dict, u_max: int,
     (shared.union_nodes does)."""
     from ..benchgen import LANE_KEYS5
 
-    step = _sharded_step_v5(mesh, u_max, k_max)
+    step = _sharded_step_v5(mesh, u_max, k_max, pipeline)
     return step(*(lanes[k] for k in LANE_KEYS5))

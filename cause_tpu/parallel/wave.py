@@ -345,11 +345,37 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
 
     from ..benchgen import LANE_KEYS5, v5_token_budget
 
-    def dispatch_v5(sub_lanes, u):
-        """Batched v5 + device digest, one scalar-free host fetch."""
-        from ..weaver.jaxw5 import batched_merge_weave_v5
+    # BENCH_KERNEL routes the wave's kernel variant (the api-level
+    # twin of bench.py's forced-kernel knob), for the v5 family only
+    # — the wave path is segment-union by design. Unknown values fail
+    # loudly (bench.py contract): a typo must not silently time v5.
+    import os as _os
 
-        r, v, _c, ov = batched_merge_weave_v5(
+    forced = _os.environ.get("BENCH_KERNEL", "").strip()
+    if forced not in ("", "v5", "v5w", "v5f"):
+        raise ValueError(
+            f"merge_wave supports BENCH_KERNEL of v5/v5w/v5f only "
+            f"(the wave path is segment-union); got {forced!r}")
+    pipeline = forced or "v5"
+
+    def dispatch_v5(sub_lanes, u):
+        """Batched v5-family dispatch + device digest, one scalar-free
+        host fetch."""
+        if pipeline == "v5f":
+            from ..weaver.jaxw5f import batched_merge_weave_v5f
+
+            def _batched(*a, u_max, k_max):
+                return batched_merge_weave_v5f(
+                    *a, u_max=u_max, k_max=k_max)
+        else:
+            from ..weaver.jaxw5 import batched_merge_weave_v5
+
+            def _batched(*a, u_max, k_max):
+                return batched_merge_weave_v5(
+                    *a, u_max=u_max, k_max=k_max,
+                    euler="walk" if pipeline == "v5w" else "doubling")
+
+        r, v, _c, ov = _batched(
             *(jnp.asarray(sub_lanes[k]) for k in LANE_KEYS5),
             u_max=u, k_max=u,
         )
@@ -365,9 +391,14 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
     if mesh is not None:
         from .mesh import sharded_merge_weave_v5
 
+        if pipeline == "v5w":
+            raise ValueError(
+                "BENCH_KERNEL=v5w has no sharded wave step; use "
+                "v5 or v5f under a mesh")
         jl = {k: jnp.asarray(v) for k, v in lanes.items()}
         rank, visible, overflow, digest, _tv, _nc, _n_ov = (
-            sharded_merge_weave_v5(mesh, jl, u_max=u_max, k_max=u_max)
+            sharded_merge_weave_v5(mesh, jl, u_max=u_max,
+                                   k_max=u_max, pipeline=pipeline)
         )
         rank = np.asarray(rank)
         visible = np.asarray(visible)

@@ -585,25 +585,24 @@ def main() -> None:
                  error=f"{type(e).__name__}: {str(e)[:200]}")
 
     # ---- the ladder, highest information value per second first -----
-    # (1) headline, always re-measured; (2) phase attribution decides
-    # the round's direction; (3) the best-guess combined config; then
-    # single-switch A/Bs to attribute whatever (3) shows; then fleet +
-    # v4 ladder point.
+    # Round-5 order: the fused pipeline (v5f) is the headline
+    # candidate — its digest gate + timing come right after the
+    # always-re-measured default headline, BEFORE the multi-compile
+    # stage attribution (a 6-minute window must land the number that
+    # can actually win).
     ladder: list[tuple[str, object, tuple]] = [
         ("bench_v5", bench_item, ("bench_v5", "v5", {}, 8, False)),
-        ("stages_default", stages_item, ("stages_default", XLA_BASE)),
-        ("verify_beststream", verify_item,
-         ("verify_beststream", XLA_BASE, "v5w", BESTSTREAM)),
-        ("bench_beststream", bench_item,
-         ("bench_beststream", "v5w", BESTSTREAM)),
-        # round-5 fused token pipeline: the new headline candidate,
-        # digest-gated like beststream, measured both ways
         ("verify_v5f", verify_item,
          ("verify_v5f", XLA_BASE, "v5f", BESTSTREAM)),
         ("bench_v5f", bench_item,
          ("bench_v5f", "v5f", BESTSTREAM)),
         ("bench_v5f_xla", bench_item,
          ("bench_v5f_xla", "v5f", XLA_BASE)),
+        ("verify_beststream", verify_item,
+         ("verify_beststream", XLA_BASE, "v5w", BESTSTREAM)),
+        ("bench_beststream", bench_item,
+         ("bench_beststream", "v5w", BESTSTREAM)),
+        ("stages_default", stages_item, ("stages_default", XLA_BASE)),
         ("bench_xla_base", bench_item,
          ("bench_xla_base", "v5", XLA_BASE)),
         ("bench_psort", bench_item,

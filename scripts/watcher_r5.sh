@@ -88,6 +88,10 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
       BS_ENV=$(PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -c "
 import sys; sys.path.insert(0, 'scripts'); import harvest
 print(' '.join(f'{k}={v}' for k, v in sorted(harvest.BESTSTREAM.items())))")
+      # the fused pipeline rides the wave too, once ITS gate certified
+      if grep -qs '"verify_v5f"' measurements/harvest_state_r5.json 2>/dev/null; then
+        BS_ENV="$BS_ENV BENCH_KERNEL=v5f"
+      fi
       note "attempt $i: api_bench wave (certified beststream: $BS_ENV)"
       HARVEST_CLAIM_DEADLINE=$(claim_remain) \
         env $BS_ENV python -u scripts/api_bench.py --wave 1024 \
