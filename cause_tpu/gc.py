@@ -21,15 +21,25 @@ is dropped, and the caches are reconstituted from the surviving bag
 (the ordinary ``refresh_caches`` path, so the compacted tree is a
 plain tree: serde, merge, sync, device weavers all Just Work).
 
-What reclaims and what cannot (the RGA skeleton reality): list causes
-chain through predecessors, so an INTERIOR tombstone that visible
-text was typed after remains as cause-chain skeleton — removing it
-would dangle every descendant. What GCs wholesale: hidden TAILS
-(delete-at-end), undone branches (hidden subtrees with no kept
-descendants), and — because map causes are keys, not chains — a map's
-entire overwritten/dissoc'd history (measured: 56/61 nodes of a
-10-overwrite LWW churn; 61/91 of a tail-delete list; interior
-deletions 0 by design).
+What reclaims and what cannot — two interior-hole rules compose:
+
+- the RGA skeleton reality: list causes chain through predecessors,
+  so an interior tombstone that visible text was typed after remains
+  as cause-chain skeleton — removing it would dangle descendants;
+- the SYNC-soundness rule (found by the round-5 soak, seed 700216):
+  only per-site yarn SUFFIXES may drop. An interior yarn hole breaks
+  the per-site prefix property sync deltas assume — a resend can
+  carry a victim whose marker (another site's interior hole) is never
+  resent, resurrecting the deletion after an ordinary sync with no
+  cause-must-exist failure to trigger the fallback. Suffix-only
+  dropping makes victim and marker travel together.
+
+What GCs wholesale under both rules: hidden TAILS (delete-at-end:
+61/91 nodes measured), undone branches, and any site whose entire
+remaining contribution is obsolete (a map writer fully superseded by
+later sites: its whole yarn drops). What stays: interior deletions,
+and same-site LWW churn (every overwritten write sits below the
+site's newest kept write — sound, and honestly 0 reclaimed).
 
 Safety valve: compaction re-renders the compacted tree and compares
 EDN with the original; any divergence (an exotic special interleaving
@@ -213,6 +223,41 @@ def compact(handle, stable_vv: Optional[dict] = None):
         }
         if unstable - keep:
             keep = _closure(nodes, keep | unstable)
+
+    # sync-soundness (round-5 soak catch, seed 700216): only per-site
+    # yarn SUFFIXES may drop. An interior hole — a dropped node below
+    # a surviving same-site node — breaks the per-site prefix property
+    # the sync deltas assume: the victim's site tip can regress (so a
+    # peer resends the victim) while the marker's site tip survives
+    # (so the marker is never resent), and the deletion resurrects
+    # VISIBLY after an ordinary sync, with no cause-must-exist failure
+    # to trigger the full-bag fallback. Suffix-only dropping makes
+    # victim and marker travel together in every resend. Fixpoint:
+    # re-kept nodes pull their markers/ancestors, which can raise a
+    # site's kept maximum again.
+    by_site: Dict[str, list] = {}
+    for nid in nodes:
+        if nid != ROOT_ID:
+            by_site.setdefault(nid[1], []).append(nid)
+    for ids in by_site.values():
+        ids.sort()
+    while True:
+        pre = len(keep)
+        for ids in by_site.values():
+            mx = None
+            for nid in reversed(ids):
+                if nid in keep:
+                    mx = nid
+                    break
+            if mx is not None:
+                for nid in ids:
+                    if nid > mx:
+                        break
+                    keep.add(nid)
+        keep = _closure(nodes, keep)
+        if len(keep) == pre:
+            break
+
     if ROOT_ID in nodes:
         keep.add(ROOT_ID)  # the sentinel head always survives
     if len(keep) >= len(nodes):

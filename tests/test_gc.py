@@ -66,20 +66,63 @@ def test_undone_branch_reclaims():
     assert na not in out.ct.nodes
 
 
-def test_map_lww_churn_reclaims_wholesale():
+def test_map_single_site_churn_declines_soundly():
+    """Same-site LWW overwrites sit below the site's newest kept
+    write — interior yarn holes, which the sync-soundness rule
+    forbids dropping. compact() honestly reclaims nothing here."""
     cm = c.cmap()
     for j in range(6):
         for o in range(10):
             cm = cm.assoc(K(f"k{j}"), f"v{o}")
     cm = cm.dissoc(K("k0"))
     out = compact(cm)
-    st = compact_stats(cm, out)
     assert c.causal_to_edn(out) == c.causal_to_edn(cm)
-    assert st["nodes_after"] <= 8  # ~one winner per surviving key
-    # undo-by-id on the surviving winner still works
+    assert compact_stats(cm, out)["dropped"] == 0
+
+
+def test_map_superseded_writer_reclaims_wholesale():
+    """A site whose entire remaining contribution is overwritten by
+    later sites drops as a whole yarn — the sound map reclamation
+    shape."""
+    from cause_tpu.collections.cmap import CausalMap
+    from cause_tpu.ids import new_site_id
+
+    cm = c.cmap()
+    for j in range(4):
+        cm = cm.append(K(f"k{j}"), f"old{j}")
+    w2 = CausalMap(cm.ct.evolve(site_id=new_site_id()))
+    for j in range(4):
+        w2 = w2.append(K(f"k{j}"), f"new{j}")
+    out = compact(w2)
+    assert c.causal_to_edn(out) == c.causal_to_edn(w2)
+    assert compact_stats(w2, out)["dropped"] == 4
+    # undo-by-id on a surviving winner still works
     k1_node = out.ct.weave[K("k1")][1]
     out2 = out.append(k1_node[0], c.hide)
     assert K("k1") not in c.causal_to_edn(out2)
+
+
+def test_no_interior_yarn_holes_ever():
+    """The sync-soundness invariant, asserted directly: after any
+    compaction, a dropped node is never below a kept same-site node
+    (soak seed 700216's resurrection shape)."""
+    import random as _r
+
+    rng = _r.Random(700216)
+    from cause_tpu.ids import new_site_id as _ns
+    for case in range(8):
+        cl = c.clist(*[str(i) for i in range(rng.randrange(1, 12))])
+        sites = [_ns() for _ in range(2)]
+        for _ in range(rng.randrange(5, 25)):
+            cl = cl.insert(rand_node(rng, cl,
+                                     site_id=rng.choice(sites)))
+        out = compact(cl)
+        dropped = set(cl.ct.nodes) - set(out.ct.nodes)
+        for nid in dropped:
+            newer_kept = [k for k in out.ct.nodes
+                          if k != (0, "0", 0) and k[1] == nid[1]
+                          and k > nid]
+            assert not newer_kept, (case, nid, newer_kept)
 
 
 def test_compacted_tree_is_first_class():
