@@ -84,3 +84,41 @@ def test_forced_kernel_is_stripped_on_cpu():
     # not leak into the CPU evidence path
     assert rec["kernel"] == "v5"
     assert rec["config"] == "default"
+
+
+def test_marshal_precedes_backend_claim():
+    """Window-economy methodology pin (round 5): the full-size host
+    marshal must run BEFORE anything that initializes the backend —
+    enable_compile_cache() consults the default backend, i.e. it IS
+    the blocking tunnel claim, and jax.devices() certainly is. A
+    regression here silently burns 60-90 s of every granted tunnel
+    window on host numpy. Asserted structurally over measure()'s
+    source: both backend-touching calls appear only after the batch
+    marshal. (harvest.py follows the same ordering; its marshal event
+    is emitted before the backend event, which the harvester's own
+    smoke exercises.)"""
+    import inspect
+
+    import bench
+
+    src = "\n".join(
+        line for line in
+        inspect.getsource(bench.measure).splitlines()
+        if not line.lstrip().startswith("#")
+    )
+    marshal_at = src.index("batched_pair_lanes(")
+    cache_at = src.index("enable_compile_cache()")
+    devices_at = src.index("jax.devices()")
+    assert marshal_at < cache_at, (
+        "enable_compile_cache() (the blocking backend claim) moved "
+        "above the marshal")
+    assert marshal_at < devices_at, (
+        "jax.devices() moved above the marshal")
+
+
+def test_reps_fields_in_artifact():
+    """Round-4 verdict weak #2: the artifact must state its
+    repetition counts (the headline is a median, not one sample)."""
+    rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_SMOKE": "1"})
+    assert rec["reps"] >= 3
+    assert rec["burst_reps"] >= 1
