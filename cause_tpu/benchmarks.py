@@ -1,4 +1,5 @@
-"""The benchmark suite: the five BASELINE configs as a CLI.
+"""The benchmark suite: the BASELINE configs (and a map-fleet
+row) as a CLI.
 
 The reference keeps criterium harnesses in REPL comment blocks and
 publishes no numbers (reference: test/causal/collections/
@@ -18,6 +19,7 @@ Configs (BASELINE.json "configs"):
   3 CausalMap key overwrite + id-caused undo/redo tombstones
   4 CausalBase nested list-in-map rich-text doc
   5 batched merge of divergent CausalLists (the north-star; device)
+  6 map-fleet wave (key-rooted forests; v5 segment-union vs v4; device)
 """
 
 from __future__ import annotations

@@ -148,7 +148,9 @@ class FleetSession:
             cap = self.capacity  # never shrink: resident shapes are fixed
         # device-resident rounds never see host value bytes: sampled
         # append-only body check on every (re-)upload (see wave.py)
-        _sampled_body_spotcheck(views)
+        _bad = _sampled_body_spotcheck(views)
+        if _bad:
+            raise next(iter(_bad.values()))
         lanes = _assemble_rows(views, cap, bufs=self._bufs)
         from ..benchgen import v5_token_budget
 
@@ -238,7 +240,9 @@ class FleetSession:
         # inside _full_upload when a branch above delegated to it (the
         # corrupt lane may be resident from a previous upload, so the
         # check always covers whole trees, not just deltas).
-        _sampled_body_spotcheck(views)
+        _bad = _sampled_body_spotcheck(views)
+        if _bad:
+            raise next(iter(_bad.values()))
 
         for r, ((va, vb), _old) in enumerate(zip(views, self._views)):
             segs_a, segs_b = va.segments(), vb.segments()

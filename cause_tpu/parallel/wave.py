@@ -113,6 +113,10 @@ def _sampled_body_spotcheck(views, k: Optional[int] = None) -> None:
     paths silently. ``WaveResult.merged`` validates fully, but fleets
     that read only digests never call it.
 
+    Returns ``{pair_index: CausalError}`` for the violating pairs
+    (the caller quarantines them; raising here would fail every
+    healthy pair in the wave — round-4 advisor finding #1).
+
     This check samples ``k`` random lanes per tree per wave and
     compares bodies with the twin via its O(1) ``lane_of`` index —
     O(k) per pair instead of O(shared base), which is the entire point
@@ -121,8 +125,9 @@ def _sampled_body_spotcheck(views, k: Optional[int] = None) -> None:
     at the north-star scale one wave already draws ~16k samples.
     """
     k = _BODY_SAMPLE if k is None else k
+    bad: dict = {}
     if k <= 0:
-        return
+        return bad
     # fresh entropy + a session counter: samples must differ both
     # across waves in one process AND across process restarts, or the
     # promised coverage accumulation never happens for one-wave-per
@@ -146,8 +151,11 @@ def _sampled_body_spotcheck(views, k: Optional[int] = None) -> None:
                         and (dn[j][1] != cause or dn[j][2] != value)):
                     # same convention as check_no_conflicting_bodies:
                     # existing_node is the merge TARGET's body (dst);
-                    # plus enough context to quarantine the replica
-                    raise s.CausalError(
+                    # plus enough context to quarantine the replica.
+                    # Collected per pair (round-4 advisor finding #1):
+                    # one corrupt replica must poison ITS pair, not
+                    # the other 1023 in the wave
+                    bad[pair_idx] = s.CausalError(
                         "This node is already in the tree and can't "
                         "be changed.",
                         {"causes": {"append-only", "edits-not-allowed"},
@@ -156,6 +164,10 @@ def _sampled_body_spotcheck(views, k: Optional[int] = None) -> None:
                          "pair": pair_idx,
                          "conflicting_side": "a" if side == 0 else "b"},
                     )
+                    break
+            if pair_idx in bad:
+                break
+    return bad
 
 
 def _assemble_rows(views: Sequence[Tuple["lanecache.LaneView",
@@ -224,7 +236,8 @@ class WaveResult:
     """
 
     def __init__(self, pairs, views, cap, rank, visible, digest,
-                 fallback_results, kernel, digest_valid=None):
+                 fallback_results, kernel, digest_valid=None,
+                 poisoned=None):
         self._pairs = pairs
         self._views = views
         self.capacity = cap
@@ -236,17 +249,27 @@ class WaveResult:
             else np.zeros(len(pairs), bool)
         )
         self._fallback = fallback_results  # {index: merged_handle}
+        self._poisoned = poisoned or {}    # {index: CausalError}
         self.kernel = kernel
 
     @property
     def fallback(self):
         return sorted(self._fallback)
 
+    @property
+    def poisoned(self):
+        """Pairs the body spot-check quarantined (a corrupt replica):
+        the rest of the wave is valid; ``merged(i)`` raises the
+        pair's own CausalError (round-4 advisor finding #1)."""
+        return sorted(self._poisoned)
+
     def __len__(self):
         return len(self._pairs)
 
     def merged(self, i: int):
         """Materialize pair ``i``'s converged tree as a host handle."""
+        if i in self._poisoned:
+            raise self._poisoned[i]
         if i in self._fallback:
             return self._fallback[i]
         a, b = self._pairs[i]
@@ -323,19 +346,29 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
             views.append((va, vb))
 
     live = [i for i, v in enumerate(views) if v is not None]
+    # device paths never see host value bytes; the sampled host-side
+    # check quarantines corrupt PAIRS (merged(i) raises for them
+    # alone) instead of failing the healthy rest of the wave
+    poisoned = {}
+    if live:
+        bad = _sampled_body_spotcheck([views[i] for i in live])
+        for local_idx, err in bad.items():
+            i = live[local_idx]
+            poisoned[i] = err
+            views[i] = None
+        live = [i for i, v in enumerate(views) if v is not None]
     if not live:
         B = len(pairs)
         return WaveResult(pairs, views, 0,
                           np.zeros((B, 0), np.int32),
                           np.zeros((B, 0), bool),
-                          np.zeros(B, np.uint32), fallback, "host")
+                          np.zeros(B, np.uint32), fallback, "host",
+                          poisoned=poisoned)
 
     cap = next_pow2(max(
         max(va.n, vb.n) for i in live for va, vb in [views[i]]
     ))
     live_views = [views[i] for i in live]
-    # device paths never see host value bytes; sampled host-side check
-    _sampled_body_spotcheck(live_views)
     if mesh is not None and len(live_views) % mesh.size:
         # fallbacks shrank the batch below mesh divisibility: pad with
         # copies of the first live row and drop their outputs below
@@ -440,4 +473,5 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
         full_dig[i] = digest[j]
         dig_valid[i] = True
     return WaveResult(pairs, views, cap, full_rank, full_vis, full_dig,
-                      fallback, "v5", dig_valid)
+                      fallback, pipeline, dig_valid,
+                      poisoned=poisoned)
