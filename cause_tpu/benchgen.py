@@ -189,15 +189,21 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
     take the ``LANE_KEYS`` lanes, v4/v4w the ``LANE_KEYS4`` lanes, v5
     the ``LANE_KEYS5`` lanes.
     """
-    import os as _os
+    # the CAUSE_TPU_* streaming switches are read at TRACE TIME inside
+    # the kernels (via switches.resolve), so they are part of program
+    # identity. The cache key uses the RAW env values, not resolve():
+    # resolve() consults jax.default_backend() once TPU_DEFAULTS is
+    # populated, and this lookup runs on host paths (bench.py's parent,
+    # the wave assembly) that must stay backend-init-free — triggering
+    # the blocking tunnel claim from a cache lookup was ADVICE r4 #2.
+    # switches.raw_key: raw env values (never resolve() — that would
+    # trigger backend init from this host path), with the safe
+    # "xla"-onto-unset collapse for non-defaulted switches; the
+    # mapping lives in switches.py next to resolve() so key and
+    # trace-time resolution cannot drift.
+    from .switches import TRACE_SWITCHES, raw_key
 
-    # the CAUSE_TPU_* streaming switches are read at TRACE time inside
-    # the kernels, so they are part of the program identity
-    from .switches import TRACE_SWITCHES, resolve
-
-    # resolved (not raw-env) values: backend-conditional defaults are
-    # part of program identity too
-    switches = tuple(resolve(k) for k in TRACE_SWITCHES)
+    switches = tuple(raw_key(k) for k in TRACE_SWITCHES)
     key = (k_max, kernel if k_max > 0 else "v1", u_max, switches)
     program = _scalar_programs.get(key)
     if program is None:

@@ -102,7 +102,7 @@ _BODY_SAMPLE = int(os.environ.get("CAUSE_TPU_BODY_SAMPLE", "16") or 0)
 _wave_seq = itertools.count()
 
 
-def _sampled_body_spotcheck(views, k: Optional[int] = None) -> None:
+def _sampled_body_spotcheck(views, k: Optional[int] = None) -> dict:
     """Close the device value-byte blind spot probabilistically.
 
     The kernels dedupe twin segments by ids/classes/structure; host
@@ -222,9 +222,13 @@ class WaveResult:
 
     - ``digest``: [B] uint32 per-pair weave digests (equal digests =>
       identical converged linearizations; see mesh.replica_digest) —
-      ONLY where ``digest_valid`` is True. Fallback/overflow rows have
-      no device digest (digest_valid False, value 0); compare their
-      ``merged`` trees instead;
+      ONLY where ``digest_valid`` is True. digest_valid is False for
+      TWO distinct categories a digest-only consumer must check
+      separately: ``fallback`` rows (host path ran; compare their
+      ``merged`` trees instead) and ``poisoned`` rows (a corrupt
+      replica was caught — see the ``poisoned`` property for the
+      sources; ``merged(i)`` raises that pair's CausalError — these
+      rows have NO valid result);
     - ``rank``/``visible``: [B, 2*cap] per-concat-lane outputs of the
       v5 kernel (rank == 2*cap for dropped/duplicate/padding lanes);
     - ``merged(i)``: the converged CausalList of pair i as a host
@@ -258,9 +262,14 @@ class WaveResult:
 
     @property
     def poisoned(self):
-        """Pairs the body spot-check quarantined (a corrupt replica):
-        the rest of the wave is valid; ``merged(i)`` raises the
-        pair's own CausalError (round-4 advisor finding #1)."""
+        """Pairs quarantined with their own CausalError — the rest of
+        the wave is valid; ``merged(i)`` raises the pair's error
+        (round-4 advisor finding #1). Three sources: the sampled body
+        spot-check on device rows (probabilistic — CAUSE_TPU_BODY_SAMPLE
+        tunes/disables it), and the merge-time validation of host
+        fallback and overflow rows (deterministic — those pairs run
+        ``a.merge(b)`` eagerly, so a corrupt replica there is caught
+        even with sampling off)."""
         return sorted(self._poisoned)
 
     def __len__(self):
@@ -328,6 +337,7 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
 
     views: List[Optional[Tuple[object, object]]] = []
     fallback = {}
+    poisoned: dict = {}
     for i, (a, b) in enumerate(pairs):
         # view_for returns None for map trees (they need the mapw
         # forest encoding) and off-domain ids: both take the correct
@@ -340,7 +350,17 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
             va = lanecache.build_view(a.ct.nodes, a.ct.uuid)
             vb = lanecache.build_view(b.ct.nodes, b.ct.uuid)
         if va is None or vb is None or not lanecache.compatible((va, vb)):
-            fallback[i] = a.merge(b)
+            try:
+                fallback[i] = a.merge(b)
+            except s.CausalError as err:
+                # the per-pair quarantine contract covers the host
+                # fallback path too: a corrupt replica that is ALSO
+                # off the device domain must poison its own pair, not
+                # abort the other pairs' wave (mergeability of every
+                # pair was already checked wave-wide above — what can
+                # raise here is the merge-time body validation)
+                err.info["pair"] = i
+                poisoned[i] = err
             views.append(None)
         else:
             views.append((va, vb))
@@ -349,11 +369,15 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
     # device paths never see host value bytes; the sampled host-side
     # check quarantines corrupt PAIRS (merged(i) raises for them
     # alone) instead of failing the healthy rest of the wave
-    poisoned = {}
     if live:
         bad = _sampled_body_spotcheck([views[i] for i in live])
         for local_idx, err in bad.items():
             i = live[local_idx]
+            # the spot-check saw the COMPACTED live list; remap its
+            # pair index to the wave's, or a caller quarantining by
+            # info["pair"] would hit a healthy pair whenever a
+            # fallback pair precedes the corrupt one
+            err.info["pair"] = i
             poisoned[i] = err
             views[i] = None
         live = [i for i, v in enumerate(views) if v is not None]
@@ -465,7 +489,12 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
     for j, i in enumerate(live):
         if bool(overflow[j]):
             a, b = pairs[i]
-            fallback[i] = a.merge(b)  # budget blown: host path, correct
+            try:
+                # budget blown: host path, correct
+                fallback[i] = a.merge(b)
+            except s.CausalError as err:  # corrupt AND overflowed
+                err.info["pair"] = i
+                poisoned[i] = err
             views[i] = None
             continue
         full_rank[i] = rank[j]

@@ -37,6 +37,27 @@ TPU_DEFAULTS: dict = {
 }
 
 
+def raw_key(name: str) -> str:
+    """Backend-init-free cache-key value for ``name``: the raw env
+    value, with the explicit "xla" sentinel collapsed onto unset ONLY
+    for switches without a TPU_DEFAULTS entry (for those, both resolve
+    to "" on every backend, so the traced programs are identical). A
+    DEFAULTED switch keeps them distinct: unset means "apply the
+    default on TPU", "xla" means "force the XLA lowering". Lives here,
+    next to resolve(), so the key mapping and the trace-time
+    resolution can never drift apart (module rule: import, never
+    restate). Sound as a program-cache key because the backend is
+    process-constant after init — env -> resolved is one mapping per
+    process (ADVICE r4 #2: the key path must never trigger backend
+    init, so it cannot call resolve())."""
+    import os
+
+    v = os.environ.get(name, "").strip()
+    if v == "xla" and name not in TPU_DEFAULTS:
+        return ""
+    return v
+
+
 def resolve(name: str) -> str:
     """The effective strategy for ``name`` at trace time: the env var
     if set ("xla" = force the XLA-default lowering), else the

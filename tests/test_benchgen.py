@@ -78,3 +78,83 @@ def test_batched_shape():
     batch = bg.batched_pair_lanes(4, 3, 2, 8, hide_every=0)
     assert batch["hi"].shape == (4, 16)
     assert all(v.shape[0] == 4 for v in batch.values())
+
+
+def test_scalar_program_cache_hit_is_backend_init_free(monkeypatch):
+    """ADVICE r4 #2: the merge_wave_scalar program-cache lookup runs on
+    host paths (bench.py's parent process, wave assembly) that must
+    never trigger jax backend init — but switches.resolve() consults
+    jax.default_backend() the moment TPU_DEFAULTS is populated. The
+    cache key therefore uses RAW env values (sound: the backend is
+    process-constant after init, so env -> resolved is one mapping per
+    process). This test pins the contract: with TPU_DEFAULTS non-empty
+    and resolve() booby-trapped, a cache hit must still be served."""
+    from cause_tpu import benchgen as bg_mod
+    from cause_tpu import switches
+
+    for k in switches.TRACE_SWITCHES:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setattr(
+        switches, "TPU_DEFAULTS", {"CAUSE_TPU_SORT": "pallas"})
+
+    def boom(name):  # pragma: no cover - the assertion IS the test
+        raise AssertionError(
+            "switches.resolve() called on the program-cache key path")
+
+    monkeypatch.setattr(switches, "resolve", boom)
+
+    key = (7, "v5", 7, ("",) * len(switches.TRACE_SWITCHES))
+    seen = []
+    sentinel = object()
+
+    def fake_program(*a):
+        seen.append(a)
+        return sentinel
+
+    monkeypatch.setitem(bg_mod._scalar_programs, key, fake_program)
+    out = bg_mod.merge_wave_scalar(1, 2, k_max=7, kernel="v5", u_max=7)
+    assert out is sentinel
+    assert seen == [(1, 2)]
+
+
+def test_scalar_program_cache_key_xla_collapse(monkeypatch):
+    """The explicit "xla" value and unset share a cache key ONLY for
+    switches without a TPU_DEFAULTS entry (where they resolve
+    identically on every backend). A defaulted switch keeps them
+    distinct: unset applies the default on TPU, "xla" forces the XLA
+    lowering — collapsing those would serve the wrong program."""
+    from cause_tpu import benchgen as bg_mod
+    from cause_tpu import switches
+
+    for k in switches.TRACE_SWITCHES:
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setattr(
+        switches, "TPU_DEFAULTS", {"CAUSE_TPU_SORT": "pallas"})
+
+    hits = []
+
+    def fake_program(*a):
+        hits.append(a)
+        return "sentinel"
+
+    base = ["" for _ in switches.TRACE_SWITCHES]
+    # non-defaulted switch: "xla" collapses onto the unset key
+    monkeypatch.setitem(
+        bg_mod._scalar_programs, (7, "v5", 7, tuple(base)), fake_program)
+    monkeypatch.setenv("CAUSE_TPU_GATHER", "xla")
+    assert bg_mod.merge_wave_scalar(
+        1, k_max=7, kernel="v5", u_max=7) == "sentinel"
+    monkeypatch.delenv("CAUSE_TPU_GATHER")
+
+    # defaulted switch: "xla" must NOT hit the unset entry
+    monkeypatch.setenv("CAUSE_TPU_SORT", "xla")
+    si = switches.TRACE_SWITCHES.index("CAUSE_TPU_SORT")
+    distinct = list(base)
+    distinct[si] = "xla"
+    probe = []
+    monkeypatch.setitem(
+        bg_mod._scalar_programs, (7, "v5", 7, tuple(distinct)),
+        lambda *a: probe.append(a) or "forced-xla")
+    assert bg_mod.merge_wave_scalar(
+        1, k_max=7, kernel="v5", u_max=7) == "forced-xla"
+    assert len(hits) == 1 and len(probe) == 1

@@ -307,3 +307,50 @@ def test_spotcheck_clean_pairs_pass(monkeypatch):
     assert not res.fallback
     for i, (x, y) in enumerate(pairs):
         assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(x.merge(y))
+
+
+def test_corrupt_pair_after_fallback_remaps_pair_index(monkeypatch):
+    """The spot-check sees the COMPACTED live list; when a fallback
+    pair precedes the corrupt one, info["pair"] must still name the
+    WAVE index (round-5 review finding: without the remap a caller
+    quarantining by info["pair"] hits a healthy pair)."""
+    from cause_tpu.collections.cmap import CausalMap
+    from cause_tpu.parallel import wave as wave_mod
+
+    monkeypatch.setattr(wave_mod, "_BODY_SAMPLE", 10**9)
+    m = c.cmap()
+    ma = CausalMap(m.ct.evolve(site_id=new_site_id())).append(c.K("x"), "1")
+    mb = CausalMap(m.ct.evolve(site_id=new_site_id())).append(c.K("y"), "2")
+    a, b = _corrupt_pair()
+    healthy = make_pairs(1)
+    res = merge_wave([(ma, mb), healthy[0], (a, b)])
+    assert res.fallback == [0]          # the map pair (host path)
+    assert res.poisoned == [2]
+    with pytest.raises(c.CausalError) as ei:
+        res.merged(2)
+    assert ei.value.info["pair"] == 2   # wave index, not live index 1
+    x, y = healthy[0]
+    assert c.causal_to_edn(res.merged(1)) == c.causal_to_edn(x.merge(y))
+
+
+def test_corrupt_fallback_pair_poisons_itself(monkeypatch):
+    """A corrupt replica that is ALSO off the device domain (host
+    fallback path) must poison its own pair, not abort the wave for
+    the healthy pairs (round-5 review finding: the eager fallback
+    a.merge(b) used to raise out of merge_wave)."""
+    from cause_tpu.parallel import wave as wave_mod
+
+    monkeypatch.setattr(wave_mod, "_BODY_SAMPLE", 10**9)
+    # force EVERY pair onto the host fallback path
+    monkeypatch.setattr(wave_mod.lanecache, "view_for", lambda ct: None)
+    a, b = _corrupt_pair()
+    healthy = make_pairs(2)
+    res = merge_wave([healthy[0], (a, b), healthy[1]])
+    assert res.poisoned == [1]
+    assert sorted(res.fallback) == [0, 2]
+    with pytest.raises(c.CausalError) as ei:
+        res.merged(1)
+    assert "append-only" in ei.value.info["causes"]
+    assert ei.value.info["pair"] == 1
+    for i, (x, y) in ((0, healthy[0]), (2, healthy[1])):
+        assert c.causal_to_edn(res.merged(i)) == c.causal_to_edn(x.merge(y))
