@@ -251,16 +251,30 @@ def measure(platform: str) -> dict:
               (budget, "v4"), (2 * budget, "v4"),
               (2 * budget, "v2"), (0, "v1")]
     forced = os.environ.get("BENCH_KERNEL", "").strip()
+    explicit = bool(forced)
+    if not forced:
+        # a chip-certified kernel from a measuring window ships as the
+        # default first rung (switches._tpu_defaults.json, written by
+        # harvest's decide_defaults); v5 is already the ladder head
+        from cause_tpu.switches import measured_kernel
+        forced = measured_kernel()
+        if forced == "v5":
+            forced = ""
     if forced:
         # budget units differ per family: tokens for v5*, runs for the
-        # contracted kernels; an unknown name must fail loudly, not
-        # silently time v2 under the forced label
+        # contracted kernels; an unknown ENV name must fail loudly, not
+        # silently time v2 under the forced label — a stale defaults
+        # file naming an unknown kernel is ignored instead
         family = {"v5": u_budget, "v5w": u_budget,
                   "v5f": u_budget, "v4": budget,
                   "v4w": budget, "v3": 2 * budget, "v2": 2 * budget}
         if forced not in family:
-            raise SystemExit(f"bench: unknown BENCH_KERNEL {forced!r}; "
-                             f"one of {sorted(family)}")
+            if explicit:
+                raise SystemExit(
+                    f"bench: unknown BENCH_KERNEL {forced!r}; "
+                    f"one of {sorted(family)}")
+            forced = ""
+    if forced:
         fb = family[forced]
         ladder = [(fb, forced), (2 * fb, forced)] + ladder
     _bail_if_abandoned()
@@ -285,14 +299,19 @@ def measure(platform: str) -> dict:
         [burst(k_max, kernel) for _ in range(burst_reps)]
     ))
 
-    # On real hardware, also try the "beststream" configuration
-    # (rowgather + the VMEM-resident pallas sort network + matrix
-    # search — every random access becomes a streaming or on-chip
-    # pass; bit-identical by the parity suites; NOT round 3's
-    # "allstream", which used the HBM-round-tripping XLA bitonic) and
-    # keep whichever is faster. Guarded by elapsed time so a slow alt
-    # compile can't eat the whole budget, and by BENCH_NO_ALLSTREAM
-    # for the watcher's isolated A/B runs.
+    # On real hardware, also try ONE alternative configuration and
+    # keep whichever is faster. With chip-certified defaults on disk
+    # (switches._tpu_defaults.json) the default path above already ran
+    # the winners, so the alternative is the forced-XLA baseline (the
+    # A/B re-confirms the winners on today's chip); with no certified
+    # defaults yet, the alternative is the XLA-only streaming
+    # candidate (rowgather + matrix search + scatter hints). NEVER an
+    # uncertified Mosaic config here: round-5 window-1 evidence is
+    # that Mosaic compiles crash or HANG this tunnel's remote compile
+    # helper, and a hang at the round-end bench would cost the
+    # driver's artifact. Guarded by elapsed time so a slow alt compile
+    # can't eat the whole budget, and by BENCH_NO_ALLSTREAM for the
+    # watcher's isolated A/B runs.
     preset = [f"{k.split('_')[-1].lower()}={os.environ[k]}"
               for k in TRACE_SWITCHES if os.environ.get(k)]
     config = "+".join(preset) if preset else "default"
@@ -308,12 +327,25 @@ def measure(platform: str) -> dict:
     alt = None
     _bail_if_abandoned()
     if want_alt:
-        # pallas (VMEM-resident network) rather than bitonic (the
-        # XLA-level network round-trips every stage through HBM)
-        os.environ["CAUSE_TPU_SORT"] = "pallas"
-        os.environ["CAUSE_TPU_GATHER"] = "rowgather"
-        os.environ["CAUSE_TPU_SEARCH"] = "matrix-table"
-        os.environ["CAUSE_TPU_SCATTER"] = "hint"
+        from cause_tpu.switches import TPU_DEFAULTS as _certified
+
+        if _certified:
+            # default above = the certified winners; alt = baseline.
+            # The label names the kernel: with a non-v5 certified
+            # kernel this A/B is xla-switches-under-that-kernel, NOT
+            # the v5 XLA baseline the certification was made against
+            # (decide_defaults only ever certifies v5 today, so in
+            # practice this IS the true baseline)
+            for k in TRACE_SWITCHES:
+                os.environ[k] = "xla"
+            alt_label = ("xla-baseline" if kernel == "v5"
+                         else f"xla-switches-{kernel}")
+            config = "measured-defaults"
+        else:
+            os.environ["CAUSE_TPU_GATHER"] = "rowgather"
+            os.environ["CAUSE_TPU_SEARCH"] = "matrix-table"
+            os.environ["CAUSE_TPU_SCATTER"] = "hint"
+            alt_label = "beststream"
         # the switches are read at TRACE time inside module-level
         # jitted kernels whose caches key on avals only — without a
         # cache clear the "allstream" attempt would silently re-trace
@@ -331,7 +363,7 @@ def measure(platform: str) -> dict:
             ))
             # swap only now: every alt measurement succeeded
             if alt_amortized < p50_amortized:
-                config = "beststream"
+                config = alt_label
                 alt = p50_amortized
                 p50_amortized = alt_amortized
                 p50_single = alt_single
@@ -340,7 +372,7 @@ def measure(platform: str) -> dict:
             else:
                 alt = alt_amortized
         except Exception as e:  # noqa: BLE001 - keep the default result
-            print(f"bench: allstream attempt failed "
+            print(f"bench: alt config ({alt_label}) attempt failed "
                   f"({type(e).__name__}: {str(e)[:120]}); "
                   "keeping default", file=sys.stderr)
         finally:

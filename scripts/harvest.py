@@ -52,10 +52,17 @@ import time
 import numpy as np
 
 T0 = time.monotonic()
+# one id per harvest process = per window attempt; stamped into every
+# result so decide_defaults can require same-window comparisons
+RUN_ID = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()) + f"-{os.getpid()}"
 STATE_PATH = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "measurements", "harvest_state_r5.json",
 )
+# bump when an item NAME keeps its meaning but its config/kernel
+# changes (round-5 review finding: stale done/results entries from an
+# older definition must not certify a config that was never verified)
+STATE_VERSION = 2
 
 from cause_tpu.switches import TRACE_SWITCHES as SWITCHES  # noqa: E402
 
@@ -77,14 +84,29 @@ def cfg_of(**over):
 ALLSTREAM = cfg_of(CAUSE_TPU_SORT="bitonic",
                    CAUSE_TPU_GATHER="rowgather",
                    CAUSE_TPU_SEARCH="matrix")
-# the round-5 headline candidate: VMEM-resident pallas sort +
-# streaming gathers + matrix search + sequential euler walk +
-# the fused F-phase tile-window expansion (round 5)
-BESTSTREAM = cfg_of(CAUSE_TPU_SORT="pallas",
-                    CAUSE_TPU_GATHER="rowgather",
+# The headline candidate CONFIG the watcher/bench ride when certified:
+# XLA-ONLY streaming strategies. Round-5 window-1 evidence
+# (measurements/harvest_tpu_r5.log): every Mosaic kernel submitted to
+# this tunnel's remote compile helper either crashes it (HTTP 500,
+# subprocess exit 1 — v5f, fphase) or HANGS it indefinitely (the
+# pallas sort wedged bench_psort for 30+ min of open window). Mosaic
+# -flavored items therefore sit behind HARVEST_TRY_MOSAIC=1 below, and
+# the certifiable beststream contains no Mosaic strategy.
+BESTSTREAM = cfg_of(CAUSE_TPU_GATHER="rowgather",
                     CAUSE_TPU_SEARCH="matrix-table",
-                    CAUSE_TPU_SCATTER="hint",
-                    CAUSE_TPU_FPHASE="pallas")
+                    CAUSE_TPU_SCATTER="hint")
+# the aspirational full-Mosaic config (VMEM-resident pallas sort +
+# fused F-phase), measurable only where the compile helper supports
+# Mosaic — opt in with HARVEST_TRY_MOSAIC=1
+MOSAICSTREAM = cfg_of(CAUSE_TPU_SORT="pallas",
+                      CAUSE_TPU_GATHER="rowgather",
+                      CAUSE_TPU_SEARCH="matrix-table",
+                      CAUSE_TPU_SCATTER="hint",
+                      CAUSE_TPU_FPHASE="pallas")
+# strategy pairs that require a Mosaic kernel compile
+MOSAIC_VALUES = {"CAUSE_TPU_SORT=pallas", "CAUSE_TPU_FPHASE=pallas",
+                 "euler=walk", "kernel=v5f"}
+TRY_MOSAIC = os.environ.get("HARVEST_TRY_MOSAIC", "").strip() == "1"
 
 
 def emit(**obj):
@@ -93,18 +115,33 @@ def emit(**obj):
     print(json.dumps(obj), flush=True)
 
 
-def load_state() -> set:
+def load_state() -> tuple:
+    """(done item-name set, per-item results dict). Results accumulate
+    across windows; a STATE_VERSION mismatch discards everything (the
+    old entries certified item definitions that no longer exist)."""
     try:
         with open(STATE_PATH) as f:
-            return set(json.load(f)["done"])
+            data = json.load(f)
+        if data.get("version") != STATE_VERSION:
+            return set(), {}
+        done = set(data["done"])
+        # shipped defaults must re-certify every window: once the
+        # defaults file exists, verify_beststream is never "done"
+        # (round-5 review finding: a certification must not outlive
+        # its evidence — without this, a post-certification kernel
+        # regression would ship wrong results forever)
+        if os.path.exists(defaults_file_path()):
+            done.discard("verify_beststream")
+        return done, dict(data.get("results", {}))
     except Exception:  # noqa: BLE001 - missing/corrupt state = fresh
-        return set()
+        return set(), {}
 
 
-def save_state(done: set) -> None:
+def save_state(done: set, results: dict) -> None:
     os.makedirs(os.path.dirname(STATE_PATH), exist_ok=True)
     with open(STATE_PATH, "w") as f:
-        json.dump({"done": sorted(done)}, f)
+        json.dump({"version": STATE_VERSION, "done": sorted(done),
+                   "results": results}, f)
 
 
 def set_config(cfg: dict) -> None:
@@ -190,7 +227,7 @@ def main() -> None:
     np.asarray(jax.jit(lambda x: x + 1)(jnp.ones(8)))
     emit(ev="alive", platform=plat)
 
-    done = load_state()
+    done, results = load_state()
     reps = a.reps
     # a CPU rehearsal or a smoke-shape run must not mark ladder items
     # done: the state file gates what a real full-size window measures
@@ -259,6 +296,26 @@ def main() -> None:
             return True
         return False
 
+    def mosaic_gate(name, kernel, cfg) -> bool:
+        """True (and emits the skip) for items needing a Mosaic kernel
+        compile, unless HARVEST_TRY_MOSAIC=1. Round-5 window-1
+        evidence: this tunnel's remote compile helper crashes (HTTP
+        500) or hangs INDEFINITELY on Mosaic programs — bench_psort
+        wedged 30+ minutes of open window with no recourse (a hung
+        compile cannot be killed without risking the tunnel server).
+        Gated items count as attempted so the watcher advances."""
+        if TRY_MOSAIC:
+            return False
+        need = effective_values(kernel, cfg) & MOSAIC_VALUES
+        if need:
+            emit(ev="skip", item=name,
+                 reason=f"needs Mosaic compile {sorted(need)}; this "
+                        "tunnel's compile helper crashes or hangs on "
+                        "Mosaic (set HARVEST_TRY_MOSAIC=1 to retry)")
+            skipped_suspect.add(name)
+            return True
+        return False
+
     def dispatch(kernel, k):
         lanes = (LANE_KEYS5 if kernel in ("v5", "v5w", "v5f")
                  else LANE_KEYS4)
@@ -271,7 +328,8 @@ def main() -> None:
     def bench_item(name, kernel, cfg, burst_n=8, record=True):
         """bench.py-methodology measurement of one kernel+config:
         single-dispatch p50 and amortized-burst p50, reps each."""
-        if suspect_gate(name, kernel, cfg):
+        if mosaic_gate(name, kernel, cfg) or suspect_gate(
+                name, kernel, cfg):
             return
         set_config(cfg)
         k = u_budget if kernel in ("v5", "v5w", "v5f") else budget
@@ -300,18 +358,25 @@ def main() -> None:
             label = "+".join(
                 f"{k_.split('_')[-1].lower()}={v}"
                 for k_, v in sorted(cfg.items()) if v != "xla")
-            emit(ev="result", item=name, kernel=kernel,
-                 config=label or ("xla-baseline" if cfg
-                                  else "shipped-default"),
-                 p50_single_ms=round(float(np.median(singles)), 1),
-                 p50_amortized_ms=round(float(np.median(bursts)), 1),
-                 singles_ms=[round(x, 1) for x in singles],
-                 bursts_ms=[round(x, 1) for x in bursts],
-                 k_max=int(k), platform=plat, shape=f"{B}x{1+NB+ND}")
+            rec = dict(
+                item=name, kernel=kernel,
+                config=label or ("xla-baseline" if cfg
+                                 else "shipped-default"),
+                p50_single_ms=round(float(np.median(singles)), 1),
+                p50_amortized_ms=round(float(np.median(bursts)), 1),
+                singles_ms=[round(x, 1) for x in singles],
+                bursts_ms=[round(x, 1) for x in bursts],
+                k_max=int(k), platform=plat, shape=f"{B}x{1+NB+ND}",
+                run=RUN_ID)
+            emit(ev="result", **rec)
             validated_k[kernel] = k
-            if record and record_state:
-                done.add(name)
-                save_state(done)
+            if record_state:
+                # results persist for decide_defaults even for the
+                # always-re-measured headline items (latest wins)
+                results[name] = rec
+                if record:
+                    done.add(name)
+                save_state(done, results)
         except _Overflow:
             emit(ev="error", item=name, error="overflow at max budget")
         finally:
@@ -335,6 +400,8 @@ def main() -> None:
         MATCH); done only on MATCH with zero overflow on both sides."""
         from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
 
+        if mosaic_gate(name, kernel_b, cfg_b):
+            return
         if "v5" not in validated_k:
             emit(ev="error", item=name,
                  error="no bench-validated v5 budget this attempt; "
@@ -395,7 +462,7 @@ def main() -> None:
             if ok:
                 if record_state:
                     done.add(name)
-                    save_state(done)
+                    save_state(done, results)
                 return
             # attribute the culprit: one switch (or the euler walk)
             # at a time against the same baseline digests. Snapshot
@@ -448,7 +515,7 @@ def main() -> None:
         practice always) — the stage checksums fold the overflow flag
         into a float, so an unvalidated budget could silently time a
         truncated program."""
-        if suspect_gate(name, "v5", cfg):
+        if mosaic_gate(name, "v5", cfg) or suspect_gate(name, "v5", cfg):
             return
         if "v5" not in validated_k:
             # without a bench-validated budget the stage checksums could
@@ -502,7 +569,7 @@ def main() -> None:
                  u_max=int(u_eff), shape=f"{B}x{1+NB+ND}")
             if record_state:
                 done.add(name)
-                save_state(done)
+                save_state(done, results)
         finally:
             set_config({})
 
@@ -516,7 +583,13 @@ def main() -> None:
         import tpu_microbench as mb
 
         ok = True
+        mosaic_skipped = False
         for case in mb.TOK_CASES:
+            if not TRY_MOSAIC and case == "tokpallas":
+                emit(ev="skip", item=name, case=case,
+                     reason="Mosaic compile; see mosaic_gate")
+                mosaic_skipped = True
+                continue
             try:
                 per_op, once = mb.ALL[case]()
                 emit(ev="micro", item=name, case=case,
@@ -527,8 +600,14 @@ def main() -> None:
                 emit(ev="error", item=name, case=case,
                      error=f"{type(e).__name__}: {str(e)[:200]}")
         if ok and record_state:
-            done.add(name)
-            save_state(done)
+            if mosaic_skipped:
+                # attempted for THIS window's completeness, but not
+                # done: a later HARVEST_TRY_MOSAIC=1 window must still
+                # be able to measure the gated case
+                skipped_suspect.add(name)
+            else:
+                done.add(name)
+                save_state(done, results)
 
     def fleet_item(name, K, nb, nd, cap):
         from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5_jit
@@ -579,53 +658,71 @@ def main() -> None:
                  marshal_ms=round(marshal_ms, 1), platform=plat)
             if record_state:
                 done.add(name)
-                save_state(done)
+                save_state(done, results)
         except Exception as e:  # noqa: BLE001 - keep harvesting
             emit(ev="error", item=name,
                  error=f"{type(e).__name__}: {str(e)[:200]}")
 
     # ---- the ladder, highest information value per second first -----
-    # Round-5 order: the fused pipeline (v5f) is the headline
-    # candidate — its digest gate + timing come right after the
-    # always-re-measured default headline, BEFORE the multi-compile
-    # stage attribution (a 6-minute window must land the number that
-    # can actually win).
+    # Round-5 order after window 1: the XLA-only streaming family is
+    # the only measurable candidate on this tunnel (Mosaic compiles
+    # crash/hang the compile helper — see mosaic_gate), so its digest
+    # gate + timing lead, then the baseline, then the single-switch
+    # attribution A/Bs. The Mosaic items stay listed (gated) so a
+    # tunnel that gains Mosaic support measures them via
+    # HARVEST_TRY_MOSAIC=1 without a code change.
     ladder: list[tuple[str, object, tuple]] = [
         ("bench_v5", bench_item, ("bench_v5", "v5", {}, 8, False)),
-        ("verify_v5f", verify_item,
-         ("verify_v5f", XLA_BASE, "v5f", BESTSTREAM)),
-        ("bench_v5f", bench_item,
-         ("bench_v5f", "v5f", BESTSTREAM)),
-        ("bench_v5f_xla", bench_item,
-         ("bench_v5f_xla", "v5f", XLA_BASE)),
-        ("verify_beststream", verify_item,
-         ("verify_beststream", XLA_BASE, "v5w", BESTSTREAM)),
-        ("bench_beststream", bench_item,
-         ("bench_beststream", "v5w", BESTSTREAM)),
-        ("stages_default", stages_item, ("stages_default", XLA_BASE)),
+        # record=False: the xla baseline re-measures EVERY window so
+        # decide_defaults always has a same-window (same run id)
+        # anchor — a cross-window 2% margin would certify day-to-day
+        # load drift (round-5 review finding)
         ("bench_xla_base", bench_item,
-         ("bench_xla_base", "v5", XLA_BASE)),
-        ("bench_psort", bench_item,
-         ("bench_psort", "v5", cfg_of(CAUSE_TPU_SORT="pallas"))),
-        ("bench_v5w", bench_item, ("bench_v5w", "v5w", XLA_BASE)),
+         ("bench_xla_base", "v5", XLA_BASE, 8, False)),
+        ("verify_beststream", verify_item,
+         ("verify_beststream", XLA_BASE, "v5", BESTSTREAM)),
+        # record=False like the baseline: the candidate must re
+        # -measure in the same window as its anchor or the same-run
+        # rule could never (re-)certify after window 1
+        ("bench_beststream", bench_item,
+         ("bench_beststream", "v5", BESTSTREAM, 8, False)),
         ("bench_rowgather", bench_item,
          ("bench_rowgather", "v5", cfg_of(CAUSE_TPU_GATHER="rowgather"))),
         ("bench_matrix", bench_item,
          ("bench_matrix", "v5", cfg_of(CAUSE_TPU_SEARCH="matrix"))),
+        ("bench_mtable", bench_item,
+         ("bench_mtable", "v5",
+          cfg_of(CAUSE_TPU_SEARCH="matrix-table"))),
         ("bench_schint", bench_item,
          ("bench_schint", "v5", cfg_of(CAUSE_TPU_SCATTER="hint"))),
-        ("bench_fphase", bench_item,
-         ("bench_fphase", "v5", cfg_of(CAUSE_TPU_FPHASE="pallas"))),
+        ("stages_default", stages_item, ("stages_default", XLA_BASE)),
+        ("stages_beststream", stages_item,
+         ("stages_beststream", BESTSTREAM)),
         ("bench_allstream", bench_item,
          ("bench_allstream", "v5", ALLSTREAM)),
         ("bench_bitonic", bench_item,
          ("bench_bitonic", "v5", cfg_of(CAUSE_TPU_SORT="bitonic"))),
-        ("stages_beststream", stages_item,
-         ("stages_beststream", BESTSTREAM)),
         ("microbench", micro_item, ("microbench",)),
         ("fleet64", fleet_item, ("fleet64", 64, 2_000, 200, 2_560)),
         ("fleet256", fleet_item, ("fleet256", 256, 500, 64, 1_024)),
         ("bench_v4", bench_item, ("bench_v4", "v4", XLA_BASE)),
+        # Mosaic-needing items (all skip-as-attempted unless
+        # HARVEST_TRY_MOSAIC=1; see module comment)
+        ("verify_v5f", verify_item,
+         ("verify_v5f", XLA_BASE, "v5f", MOSAICSTREAM)),
+        ("bench_v5f", bench_item,
+         ("bench_v5f", "v5f", MOSAICSTREAM)),
+        ("bench_v5f_xla", bench_item,
+         ("bench_v5f_xla", "v5f", XLA_BASE)),
+        ("verify_mosaicstream", verify_item,
+         ("verify_mosaicstream", XLA_BASE, "v5w", MOSAICSTREAM)),
+        ("bench_mosaicstream", bench_item,
+         ("bench_mosaicstream", "v5w", MOSAICSTREAM)),
+        ("bench_psort", bench_item,
+         ("bench_psort", "v5", cfg_of(CAUSE_TPU_SORT="pallas"))),
+        ("bench_v5w", bench_item, ("bench_v5w", "v5w", XLA_BASE)),
+        ("bench_fphase", bench_item,
+         ("bench_fphase", "v5", cfg_of(CAUSE_TPU_FPHASE="pallas"))),
         # bookend repeat of the headline (cross-window repetition)
         ("bench_v5_bookend", bench_item,
          ("bench_v5_bookend", "v5", {}, 8, False)),
@@ -651,11 +748,120 @@ def main() -> None:
     if suspect_values:
         attempted.add("verify_beststream")
         attempted.add("verify_v5f")
+        attempted.add("verify_mosaicstream")
     complete = all(
         name in attempted for name, _, _ in ladder
-        if name not in ("bench_v5", "bench_v5_bookend")
+        if name not in ("bench_v5", "bench_xla_base",
+                        "bench_beststream", "bench_v5_bookend")
     )
+
+    # ---- flip shipped defaults from certified wins (VERDICT r4 weak
+    # #4 / next #3): the moment a window certifies the streaming
+    # config (digest-gate MATCH => "verify_beststream" in done) AND
+    # measures it faster than the same-window XLA baseline, write it
+    # to cause_tpu/_tpu_defaults.json — switches.TPU_DEFAULTS loads it
+    # at import, so every later process (bench.py's default path, API
+    # waves, user code) ships the winner with no human in the loop.
+    if record_state:
+        decide_defaults(done, results, plat, suspects=suspect_values)
     emit(ev="done", complete=complete, platform=plat)
+
+
+def defaults_file_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "cause_tpu", "_tpu_defaults.json")
+
+
+def decide_defaults(done: set, results: dict, plat: str,
+                    path: str = "", suspects=frozenset()) -> None:
+    """Write (or revoke) chip-certified switch defaults.
+
+    Rules (each closes a round-5 review finding):
+    - Flip ONLY the whole v5-certified combination
+      (verify_beststream + bench_beststream, kernel v5): the global
+      switch defaults apply to EVERY kernel a user's wave runs —
+      which is v5 — so a combination certified under v5w/v5f
+      (MOSAICSTREAM) must not leak into v5 paths it was never
+      digest-checked against. Mosaic wins are reported (ev=defaults,
+      informational) but never shipped globally; shipping them needs
+      a v5-paired digest gate first.
+    - Same-window comparison: the candidate and the xla baseline must
+      carry the same ``run`` id. PERF.md records ~14% cross-day drift
+      (4,300 -> 3,750 ms at identical code+shape); a 2% margin across
+      windows would certify pure load noise. Within one window the
+      measured spread is <2%, so the margin is meaningful.
+    - Revocation: if the currently-shipped defaults intersect this
+      attempt's digest-MISMATCH suspects, the file is deleted — a
+      certification must not outlive its evidence."""
+    path = path or defaults_file_path()
+
+    # revoke first: shipped defaults contradicted by this attempt's
+    # digest gate must go regardless of what else measured
+    if suspects and os.path.exists(path):
+        try:
+            with open(path) as f:
+                shipped = json.load(f).get("switches", {})
+        except Exception:  # noqa: BLE001 - corrupt file: revoke it
+            shipped = {"corrupt": "file"}
+        shipped_vals = {f"{k}={v}" for k, v in shipped.items()}
+        if (shipped_vals & set(suspects)) or "corrupt" in shipped:
+            os.remove(path)
+            emit(ev="defaults", flipped=False, revoked=True,
+                 reason=f"shipped defaults intersect digest suspects "
+                        f"{sorted(shipped_vals & set(suspects))}")
+            return
+
+    base_rec = results.get("bench_xla_base", {})
+    base = base_rec.get("p50_amortized_ms")
+    if not base:
+        emit(ev="defaults", flipped=False,
+             reason="no xla baseline measured; flip logic cannot rule")
+        return
+    cand = results.get("bench_beststream", {})
+    p50 = cand.get("p50_amortized_ms")
+    same_window = (cand.get("run") and
+                   cand.get("run") == base_rec.get("run"))
+    # informational only: Mosaic-combination wins (never shipped, see
+    # docstring) — same-window rule applies to the report too
+    for verify, bench in (("verify_mosaicstream", "bench_mosaicstream"),
+                          ("verify_v5f", "bench_v5f")):
+        mrec = results.get(bench, {})
+        m = mrec.get("p50_amortized_ms")
+        if (verify in done and m and m < base
+                and mrec.get("run") == base_rec.get("run")):
+            emit(ev="defaults", flipped=False, informational=True,
+                 reason=f"{bench} ({m} ms) beats base ({base} ms) but "
+                        "is certified under its own kernel only; a "
+                        "v5-paired digest gate is required before "
+                        "shipping its switches globally")
+    if not ("verify_beststream" in done and p50
+            and same_window and p50 < 0.98 * base):
+        emit(ev="defaults", flipped=False,
+             reason="no v5-certified same-window config beat the xla "
+                    f"baseline by >2% (base {base} ms, "
+                    f"beststream {p50} ms, same_window={same_window})")
+        return
+    flips = {k: v for k, v in BESTSTREAM.items() if v != "xla"}
+    rec = {
+        # committed on purpose: the framework targets exactly this
+        # chip (v5e-1 behind the axon tunnel), and VERDICT r4 asks for
+        # shipped defaults to come from measured winners; CPU and
+        # other backends ignore these (switches.resolve backend guard)
+        "switches": flips,
+        "kernel": "v5",
+        "evidence": {
+            "p50_amortized_ms": p50,
+            "xla_base_ms": base,
+            "run": cand.get("run"),
+            "platform": plat,
+            "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(rec, f, indent=1)
+    emit(ev="defaults", flipped=True, p50_ms=p50, xla_base_ms=base,
+         kernel="v5", switches=flips, path=path)
 
 
 if __name__ == "__main__":
