@@ -41,8 +41,11 @@ TRACE_SWITCHES = (
 def _defaults_path() -> str:
     import os
 
-    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                        "_tpu_defaults.json")
+    # env override for subprocess-level tests (and operators pinning a
+    # defaults record explicitly); default: next to this module
+    return (os.environ.get("CAUSE_TPU_DEFAULTS_FILE", "").strip()
+            or os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "_tpu_defaults.json"))
 
 
 def _load_measured(path=None) -> dict:

@@ -359,12 +359,8 @@ def main():
         wave_bench(args)
         return
 
-    claimed_platform()
-
-    platform = None
+    platform = claimed_platform()
     for weaver in ("pure", "native", "jax"):
-        if weaver == "jax":
-            platform = jax.devices()[0].platform
         a, b = build_pair(args.n_base, args.n_div, weaver)
         p50 = timed(lambda: a.merge(b))
         print(json.dumps({

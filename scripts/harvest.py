@@ -563,11 +563,14 @@ def main() -> None:
                 prev = med
             label = "+".join(sorted(
                 v for v in cfg.values() if v != "xla"))
-            emit(ev="result", item=name, stages=table, platform=plat,
-                 config=label or ("xla-baseline" if cfg
-                                  else "shipped-default"),
-                 u_max=int(u_eff), shape=f"{B}x{1+NB+ND}")
+            rec = dict(item=name, stages=table, platform=plat,
+                       config=label or ("xla-baseline" if cfg
+                                        else "shipped-default"),
+                       u_max=int(u_eff), shape=f"{B}x{1+NB+ND}",
+                       run=RUN_ID)
+            emit(ev="result", **rec)
             if record_state:
+                results[name] = rec
                 done.add(name)
                 save_state(done, results)
         finally:
@@ -650,13 +653,16 @@ def main() -> None:
                 t0 = time.perf_counter()
                 step(k)
                 ts.append((time.perf_counter() - t0) * 1000)
-            emit(ev="result", item=name,
-                 metric=f"fleet v5 {K}x{1+nb+nd} -> one tree",
-                 p50_ms=round(float(np.median(ts)), 1),
-                 reps_ms=[round(x, 1) for x in ts],
-                 lanes=K * cap, u_max=int(k),
-                 marshal_ms=round(marshal_ms, 1), platform=plat)
+            rec = dict(item=name,
+                       metric=f"fleet v5 {K}x{1+nb+nd} -> one tree",
+                       p50_ms=round(float(np.median(ts)), 1),
+                       reps_ms=[round(x, 1) for x in ts],
+                       lanes=K * cap, u_max=int(k),
+                       marshal_ms=round(marshal_ms, 1), platform=plat,
+                       run=RUN_ID)
+            emit(ev="result", **rec)
             if record_state:
+                results[name] = rec
                 done.add(name)
                 save_state(done, results)
         except Exception as e:  # noqa: BLE001 - keep harvesting
@@ -768,9 +774,12 @@ def main() -> None:
 
 
 def defaults_file_path() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "cause_tpu", "_tpu_defaults.json")
+    # delegate to the consumer side: writer, revoker, re-certify check
+    # and every reader must act on the SAME file, including under the
+    # CAUSE_TPU_DEFAULTS_FILE override
+    from cause_tpu.switches import _defaults_path
+
+    return _defaults_path()
 
 
 def decide_defaults(done: set, results: dict, plat: str,

@@ -129,3 +129,35 @@ def test_reps_fields_in_artifact():
     rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_SMOKE": "1"})
     assert rec["reps"] >= 3
     assert rec["burst_reps"] >= 1
+
+
+def test_certified_defaults_file_on_cpu(tmp_path):
+    """With a measured-defaults file present (the state after a
+    certifying TPU window), the CPU fallback path must be unaffected:
+    switches.resolve ignores TPU defaults off-TPU, the certified
+    kernel (v5) leads the ladder anyway, and the artifact contract
+    holds. Guards the round-end driver run on a box where the file
+    was committed by an earlier window."""
+    p = tmp_path / "_tpu_defaults.json"
+    p.write_text(json.dumps({
+        "switches": {"CAUSE_TPU_GATHER": "rowgather",
+                     "CAUSE_TPU_SEARCH": "matrix-table",
+                     "CAUSE_TPU_SCATTER": "hint"},
+        "kernel": "v5",
+        "evidence": {"p50_amortized_ms": 1.0, "xla_base_ms": 2.0},
+    }))
+    rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_SMOKE": "1",
+                "CAUSE_TPU_DEFAULTS_FILE": str(p)})
+    assert rec["platform"] == "cpu-forced"
+    assert rec["kernel"] == "v5"
+    # CPU runs the XLA-default program; the certified label belongs to
+    # the TPU path only
+    assert rec["config"] == "default"
+
+
+def test_corrupt_defaults_file_is_ignored(tmp_path):
+    p = tmp_path / "_tpu_defaults.json"
+    p.write_text("{definitely not json")
+    rec = _run({"BENCH_FORCE_CPU": "1", "BENCH_SMOKE": "1",
+                "CAUSE_TPU_DEFAULTS_FILE": str(p)})
+    assert rec["platform"] == "cpu-forced"
