@@ -221,11 +221,18 @@ def measure(platform: str) -> dict:
             u_max=k if kernel in ("v5", "v5w", "v5f") else 0,
         )
 
+    # the most recent step()'s checksum: the alt-config gate compares
+    # it against the default program's (the kernels are semantics
+    # -preserving across strategy switches, so the sums must agree up
+    # to float32 reduction-order noise)
+    last_ck = [None]
+
     def step(k: int, kernel: str) -> None:
         # one transfer fetches checksum + overflow and forces execution
         out = np.asarray(dispatch(k, kernel))
         if k and out[1]:  # overflowed rows carry garbage ranks
             raise _Overflow()
+        last_ck[0] = float(out[0])
 
     N_BURST = int(os.environ.get("BENCH_BURST", "8"))
 
@@ -342,9 +349,13 @@ def measure(platform: str) -> dict:
                          else f"xla-switches-{kernel}")
             config = "measured-defaults"
         else:
-            os.environ["CAUSE_TPU_GATHER"] = "rowgather"
-            os.environ["CAUSE_TPU_SEARCH"] = "matrix-table"
-            os.environ["CAUSE_TPU_SCATTER"] = "hint"
+            # ONE definition of the candidate combination, in
+            # switches.py next to the registry (import, never restate
+            # — a drifted copy here would A/B a different config than
+            # harvest certifies); Mosaic-free by its own contract
+            from cause_tpu.switches import BESTSTREAM_FLIPS
+
+            os.environ.update(BESTSTREAM_FLIPS)
             alt_label = "beststream"
         # the switches are read at TRACE time inside module-level
         # jitted kernels whose caches key on avals only — without a
@@ -353,7 +364,21 @@ def measure(platform: str) -> dict:
         # itself (the outer merge_wave_scalar key alone is NOT enough)
         jax.clear_caches()
         try:
+            default_ck = last_ck[0]
             step(k_max, kernel)  # compile + overflow check
+            # gross-wrongness gate on the UNGATED self-selection path
+            # (harvest's digest gate is the real certifier; this linear
+            # checksum catches a silently-wrong strategy lowering
+            # before it can publish a fast-but-wrong artifact number —
+            # tolerance covers float32 reduction-order drift between
+            # differently-fused programs, nothing more)
+            if default_ck is not None and last_ck[0] is not None:
+                denom = max(abs(default_ck), 1.0)
+                if abs(last_ck[0] - default_ck) / denom > 1e-3:
+                    raise RuntimeError(
+                        f"alt checksum {last_ck[0]!r} deviates from "
+                        f"default {default_ck!r}; refusing to time a "
+                        "possibly-wrong program")
             alt_single = float(np.median(
                 [_timed_once(step, k_max, kernel) for _ in range(reps)]
             ))

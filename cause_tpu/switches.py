@@ -8,7 +8,7 @@ across configs / TPU pessimizations leak into CPU fallbacks. Import it
 process must be able to read it without importing jax.
 
 Values (all optional; unset = XLA default lowering):
-- CAUSE_TPU_SORT:    "bitonic" | "pallas"
+- CAUSE_TPU_SORT:    "bitonic" | "pallas" | "matrix"
 - CAUSE_TPU_GATHER:  "rowgather"
 - CAUSE_TPU_SEARCH:  "matrix" | "matrix-table"
 - CAUSE_TPU_SCATTER: "hint"
@@ -24,6 +24,22 @@ TRACE_SWITCHES = (
     "CAUSE_TPU_SCATTER",
     "CAUSE_TPU_FPHASE",
 )
+
+# The XLA-only streaming candidate combination ("beststream"): the
+# switch set the harvest ladder digest-gates and certifies, and the
+# one bench.py self-selects against when no certified defaults exist
+# yet. ONE definition on purpose (module rule: import, never restate —
+# a bench.py copy that missed a new strategy would silently A/B a
+# different config than harvest certifies). Must never name a
+# Mosaic-compiled strategy: round-5 window-1 measured this tunnel's
+# compile helper crashing or hanging on every Mosaic program, and a
+# hang at the round-end bench costs the driver artifact.
+BESTSTREAM_FLIPS = {
+    "CAUSE_TPU_SORT": "matrix",
+    "CAUSE_TPU_GATHER": "rowgather",
+    "CAUSE_TPU_SEARCH": "matrix-table",
+    "CAUSE_TPU_SCATTER": "hint",
+}
 
 # Per-backend default strategies, applied when the env var is UNSET.
 # The chip A/B ladder (scripts/harvest.py) decides what goes here: the

@@ -113,10 +113,12 @@ def bitonic_sort(operands, num_keys: int = 1):
 def sort_pairs(operands, num_keys: int = 1):
     """The kernels' sort: ``lax.sort`` by default; trace-time switch
     ``CAUSE_TPU_SORT`` selects ``bitonic`` (the XLA-level network —
-    elementwise stages, but each round-trips HBM) or ``pallas`` (the
+    elementwise stages, but each round-trips HBM), ``pallas`` (the
     same network VMEM-resident inside one Pallas kernel per 8-row
-    block — one HBM read + write per operand total) for hardware A/B
-    with no code change."""
+    block — one HBM read + write per operand total; needs a Mosaic
+    -capable backend) or ``matrix`` (blocked O(n^2) rank counting +
+    rowgather apply — pure-XLA streaming, weaver/matsort.py) for
+    hardware A/B with no code change."""
     from ..switches import resolve
 
     mode = resolve("CAUSE_TPU_SORT")
@@ -126,4 +128,8 @@ def sort_pairs(operands, num_keys: int = 1):
         from .pallas_sort import pallas_bitonic_sort
 
         return pallas_bitonic_sort(operands, num_keys=num_keys)
+    if mode == "matrix":
+        from .matsort import matrix_sort
+
+        return matrix_sort(operands, num_keys=num_keys)
     return lax.sort(tuple(operands), num_keys=num_keys)

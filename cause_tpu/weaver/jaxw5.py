@@ -348,12 +348,15 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     # With a network sort (bitonic/pallas) the payload fields RIDE the
     # sort — one roll+select per stage each, all streaming — instead of
     # five post-sort permutation gathers (the expensive primitive the
-    # strategy exists to avoid). With the default comparator sort,
-    # extra variadic operands slow the comparator, so the gather form
-    # stays. Identical results either way: same keys, same implicit
-    # -iota stability, and payload-carry == gather-by-permutation.
+    # strategy exists to avoid). The matrix rank-sort rides too: its
+    # payload apply is a streaming rowgather per operand, so carrying
+    # payloads keeps a sort=matrix-only A/B free of per-element
+    # gathers. With the default comparator sort, extra variadic
+    # operands slow the comparator, so the gather form stays.
+    # Identical results either way: same keys, same implicit-iota
+    # stability, and payload-carry == gather-by-permutation.
     su_src_in = uidx
-    ride = resolve("CAUSE_TPU_SORT") in ("bitonic", "pallas")
+    ride = resolve("CAUSE_TPU_SORT") in ("bitonic", "pallas", "matrix")
     if ride:
         (st_hi, st_lo, t_src, sv_len, sv_vc, sv_tsp_i,
          sv_lane) = sort_pairs(

@@ -195,6 +195,19 @@ def bench_tokpallas():
     )
 
 
+def bench_tokmatrix():
+    """matrix_sort at the token shape — the round-5
+    CAUSE_TPU_SORT=matrix candidate (blocked rank counting, pure-XLA
+    streaming; no Mosaic compile needed)."""
+    from cause_tpu.weaver.matsort import matrix_sort
+
+    hi, lo, src = _tok_data()
+    return _slope(
+        lambda a, b, s: matrix_sort((a, b, s), num_keys=2),
+        (hi, lo, src),
+    )
+
+
 def _scat_data():
     """Sorted-unique scatter targets: U=2252 distinct ascending lanes
     per row out of N=20480 — the index-stream shape the kernels'
@@ -282,6 +295,7 @@ ALL = {
     "toksort": bench_toksort,
     "tokbitonic": bench_tokbitonic,
     "tokpallas": bench_tokpallas,
+    "tokmatrix": bench_tokmatrix,
     "tokgather": bench_tokgather,
     "tokrowgather": bench_tokrowgather,
     "tokscatter": bench_tokscatter,
@@ -291,9 +305,10 @@ ALL = {
 }
 
 # the decision-driving subset the round-4 harvester runs in-claim
-TOK_CASES = ("toksort", "tokbitonic", "tokpallas", "tokgather",
-             "tokrowgather", "tokscatter", "tokscatterhint",
-             "searchhist", "searchmatrix", "cumsum", "elementwise")
+TOK_CASES = ("toksort", "tokbitonic", "tokpallas", "tokmatrix",
+             "tokgather", "tokrowgather", "tokscatter",
+             "tokscatterhint", "searchhist", "searchmatrix", "cumsum",
+             "elementwise")
 
 
 def main():

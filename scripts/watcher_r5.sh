@@ -87,7 +87,7 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     if grep -qs '"verify_beststream"' measurements/harvest_state_r5.json 2>/dev/null; then
       BS_ENV=$(PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -c "
 import sys; sys.path.insert(0, 'scripts'); import harvest
-print(' '.join(f'{k}={v}' for k, v in sorted(harvest.BESTSTREAM.items())))")
+print(harvest.certified_env())")
       # the fused pipeline rides the wave too, once ITS gate certified
       if grep -qs '"verify_v5f"' measurements/harvest_state_r5.json 2>/dev/null; then
         BS_ENV="$BS_ENV BENCH_KERNEL=v5f"

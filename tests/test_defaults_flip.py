@@ -98,6 +98,67 @@ def test_decide_flips_certified_winner(tmp_path, capsys):
     assert data["switches"] == rec["switches"]
 
 
+def test_decide_flips_the_timed_cfg_not_the_constant(tmp_path):
+    """Reduced-certification support: when the bench record carries
+    the cfg it actually ran (the digest gate's MATCH-REDUCED subset),
+    decide_defaults must flip exactly that — not the static BESTSTREAM
+    constant the record may have been reduced from."""
+    h = _harvest()
+    path = str(tmp_path / "_tpu_defaults.json")
+    results = _results(bench_xla_base=3750.0, bench_beststream=3000.0)
+    reduced = {"CAUSE_TPU_GATHER": "rowgather",
+               "CAUSE_TPU_SCATTER": "hint"}
+    results["bench_beststream"]["cfg"] = dict(reduced)
+    h.decide_defaults(
+        done={"verify_beststream", "bench_beststream"},
+        results=results, plat="tpu", path=path)
+    rec = json.loads(open(path).read())
+    assert rec["switches"] == reduced
+    # and the switches loader ships exactly the reduced set
+    data = sw._load_measured(path)
+    assert data["switches"] == reduced
+
+
+def test_certified_env_prefers_state_cfg(tmp_path, monkeypatch):
+    """The watcher's phase-2 wave env must ride the cfg the digest
+    gate certified (full or reduced), from the state file."""
+    h = _harvest()
+    p = tmp_path / "state.json"
+    p.write_text(json.dumps({
+        "version": h.STATE_VERSION,
+        "done": ["verify_beststream"],
+        "results": {"verify_beststream": {
+            "verdict": "MATCH-REDUCED",
+            "cfg": {"CAUSE_TPU_GATHER": "rowgather"}}},
+    }))
+    monkeypatch.setattr(h, "STATE_PATH", str(p))
+    assert h.certified_env() == "CAUSE_TPU_GATHER=rowgather"
+    # no verify record -> the static BESTSTREAM flips
+    p.write_text(json.dumps({
+        "version": h.STATE_VERSION, "done": [], "results": {}}))
+    want = " ".join(f"{k}={v}" for k, v in sorted(
+        (k, v) for k, v in h.BESTSTREAM.items() if v != "xla"))
+    assert h.certified_env() == want
+
+
+def test_persisted_suspects_reseed_from_reduced_record():
+    """A MATCH-REDUCED certification puts verify_beststream in done,
+    so later windows run no suspect re-derivation — the dropped
+    strategies must ride the record and re-seed the gate, or the next
+    window times the digest-contradicted config (review finding)."""
+    h = _harvest()
+    results = {
+        "verify_beststream": {
+            "verdict": "MATCH-REDUCED",
+            "cfg": {"CAUSE_TPU_GATHER": "rowgather"},
+            "suspects": ["CAUSE_TPU_SORT=matrix"],
+        },
+        "bench_v5": {"p50_amortized_ms": 1.0},  # no suspects field
+    }
+    assert h.persisted_suspects(results) == {"CAUSE_TPU_SORT=matrix"}
+    assert h.persisted_suspects({}) == set()
+
+
 def test_decide_requires_digest_certification(tmp_path):
     h = _harvest()
     path = str(tmp_path / "d.json")
@@ -232,7 +293,15 @@ def test_beststream_is_mosaic_free():
 
 def test_bench_alt_config_is_mosaic_free():
     """bench.py's self-selection alt path must not set a Mosaic
-    switch when no certified defaults exist."""
+    switch when no certified defaults exist. The alt config is now the
+    single shared constant (switches.BESTSTREAM_FLIPS — import, never
+    restate), so the constant is what must stay Mosaic-free; the
+    source grep keeps guarding against a reintroduced hand-written
+    env block."""
+    for k, v in sw.BESTSTREAM_FLIPS.items():
+        assert f"{k}={v}" not in _harvest().MOSAIC_VALUES, (k, v)
+    assert _harvest().BESTSTREAM == _harvest().cfg_of(
+        **sw.BESTSTREAM_FLIPS)
     src = open(os.path.join(os.path.dirname(_SCRIPTS), "bench.py")).read()
     import re
 

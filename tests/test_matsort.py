@@ -1,0 +1,104 @@
+"""matrix_sort must reproduce stable ``lax.sort`` exactly — the rank
+count with the iota tie-break defines the unique stable order, so any
+deviation is a bug, not a tie. Same oracle discipline as
+tests/test_bitonic.py; plus a kernel-level check that the full v5
+merge is bit-exact under ``CAUSE_TPU_SORT=matrix``."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import lax
+
+from cause_tpu.weaver.matsort import matrix_sort
+from cause_tpu.weaver.bitonic import sort_pairs
+
+I32_MAX = np.iinfo(np.int32).max
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 64, 100, 257, 300])
+@pytest.mark.parametrize("num_keys", [1, 2])
+def test_matches_stable_lax_sort(n, num_keys):
+    rng = np.random.RandomState(n * 10 + num_keys)
+    # few distinct values => plenty of duplicate keys to exercise the
+    # stability tie-break
+    ops = tuple(
+        jnp.asarray(rng.randint(-3, 7, size=n).astype(np.int32))
+        for _ in range(num_keys)
+    ) + (jnp.arange(n, dtype=jnp.int32) * 3,)
+    want = lax.sort(ops, num_keys=num_keys, is_stable=True)
+    got = matrix_sort(ops, num_keys=num_keys)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_batched_and_sentinels():
+    rng = np.random.RandomState(0)
+    hi = rng.randint(0, 50, size=(4, 100)).astype(np.int32)
+    hi[:, 40:] = I32_MAX  # invalid-lane sentinel region
+    lo = rng.randint(0, 50, size=(4, 100)).astype(np.int32)
+    src = np.tile(np.arange(100, dtype=np.int32), (4, 1))
+    ops = (jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(src))
+    want = lax.sort(ops, num_keys=2, is_stable=True)
+    got = matrix_sort(ops, num_keys=2)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_full_int32_range_keys():
+    # negative keys and the exact I32_MAX sentinel as REAL values, at a
+    # width that forces padding (n=300 -> p=512): pads must sort after
+    # the real sentinels, never displace them
+    keys = np.array(
+        [I32_MAX, -5, 0, I32_MAX, np.iinfo(np.int32).min, 7] * 50,
+        np.int32,
+    )
+    pay = np.arange(keys.size, dtype=np.int32)
+    ops = (jnp.asarray(keys), jnp.asarray(pay))
+    want = lax.sort(ops, num_keys=1, is_stable=True)
+    got = matrix_sort(ops, num_keys=1)
+    for w, g in zip(want, got):
+        assert np.array_equal(np.asarray(w), np.asarray(g))
+
+
+def test_sort_pairs_env_switch(monkeypatch):
+    ops = (jnp.asarray(np.array([3, 1, 2, 1], np.int32)),
+           jnp.asarray(np.array([10, 11, 12, 13], np.int32)))
+    default = sort_pairs(ops, num_keys=1)
+    monkeypatch.setenv("CAUSE_TPU_SORT", "matrix")
+    forced = sort_pairs(ops, num_keys=1)
+    for d, f in zip(default, forced):
+        assert np.array_equal(np.asarray(d), np.asarray(f))
+
+
+def test_v5_kernel_parity_under_matrix_sort(monkeypatch):
+    """The full batched v5 merge is bit-exact with every sort routed
+    through the matrix strategy (the digest gate's CPU rehearsal)."""
+    import jax
+
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=4, n_base=40, n_div=12, capacity=128, hide_every=3
+    )
+    v5batch = benchgen.batched_v5_inputs(batch, 128)
+    args = tuple(jnp.asarray(v5batch[k]) for k in LANE_KEYS5)
+    k = benchgen.v5_token_budget(v5batch)
+
+    def run():
+        rank, vis, conflict, ovf = batched_merge_weave_v5(
+            *args, u_max=k, k_max=k
+        )
+        return (np.asarray(rank), np.asarray(vis),
+                np.asarray(conflict), np.asarray(ovf))
+
+    base = run()
+    assert not base[3].any()
+    jax.clear_caches()
+    monkeypatch.setenv("CAUSE_TPU_SORT", "matrix")
+    got = run()
+    jax.clear_caches()
+    for b, g in zip(base, got):
+        assert np.array_equal(b, g)
