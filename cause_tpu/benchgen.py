@@ -185,7 +185,12 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
     token pipeline fused into Pallas kernels — jaxw5f) — with
     that run budget, returning a length-2 device array ``[checksum,
     n_overflowed_rows]`` (one transfer fetches both); ``k_max=0`` runs
-    the uncompressed v1 kernel and returns just the checksum. v1-v3
+    the uncompressed v1 kernel and returns just the checksum. For the
+    v5 family the checksum is an EXACT order-independent avalanche
+    digest of (rank, visibility, lane, conflict): equal integers
+    across strategy configs iff the weaves are bit-identical, so the
+    same scalar program doubles as the on-chip correctness gate
+    (v1-v4 keep the float sum). v1-v3
     take the ``LANE_KEYS`` lanes, v4/v4w the ``LANE_KEYS4`` lanes, v5
     the ``LANE_KEYS5`` lanes.
     """
@@ -240,12 +245,37 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
 
             @jax.jit
             def program(*a):
+                # The v5-family scalar is an EXACT avalanche digest
+                # (mesh.replica_digest-style mixing), not a float sum:
+                # uint32 wraparound arithmetic is order-independent, so
+                # the same weave under ANY strategy config yields the
+                # SAME integer — one compiled program per config serves
+                # both timing and the on-chip correctness gate
+                # (harvest's verify items and bench.py's alt-config
+                # gate compare these scalars; two windows were lost to
+                # the separate digest program's fresh compile). A
+                # linear float sum was observed cancelling
+                # compensating errors — the mixing prevents that.
                 rank, visible, conflict, overflow = batched(*a)
+                lane = jax.lax.broadcasted_iota(
+                    jnp.uint32, rank.shape, 1)
+                x = (rank.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+                     + visible.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+                     + lane * jnp.uint32(0xC2B2AE35)
+                     + jnp.uint32(1))
+                x = x ^ (x >> 16)
+                x = x * jnp.uint32(0x85EBCA6B)
+                x = x ^ (x >> 13)
+                x = x * jnp.uint32(0xC2B2AE35)
+                x = x ^ (x >> 16)
+                row = (jnp.sum(x, axis=1)
+                       ^ (conflict.astype(jnp.uint32)
+                          * jnp.uint32(0x27D4EB2F)))
+                digest = jax.lax.bitcast_convert_type(
+                    jnp.sum(row), jnp.int32)
                 return jnp.stack([
-                    jnp.sum(rank.astype(jnp.float32))
-                    + jnp.sum(visible.astype(jnp.float32))
-                    + jnp.sum(conflict.astype(jnp.float32)),
-                    jnp.sum(overflow.astype(jnp.float32)),
+                    digest,
+                    jnp.sum(overflow.astype(jnp.int32)),
                 ])
         elif k_max > 0:
             if kernel in ("v4", "v4w"):

@@ -162,7 +162,15 @@ def load_state() -> tuple:
         # regression would ship wrong results forever)
         if os.path.exists(defaults_file_path()):
             done.discard("verify_beststream")
-        return done, dict(data.get("results", {}))
+        results = dict(data.get("results", {}))
+        # a certification that did not record WHICH cfg it checked
+        # (records from code predating the cfg field) is not
+        # actionable: the static BESTSTREAM may have gained strategies
+        # since, and timing/shipping them under the old verdict would
+        # be certification drift — force a re-verify instead
+        if not (results.get("verify_beststream") or {}).get("cfg"):
+            done.discard("verify_beststream")
+        return done, results
     except Exception:  # noqa: BLE001 - missing/corrupt state = fresh
         return set(), {}
 
@@ -378,7 +386,14 @@ def main() -> None:
                 t0 = time.perf_counter()
                 np.asarray(dispatch(kernel, k))
                 singles.append((time.perf_counter() - t0) * 1000)
-            for _ in range(reps):
+            # bench.py's adaptive-burst rule (window economy, and the
+            # window-2 lesson — a slow kernel's 3 bursts are ~90 s of
+            # window for nothing): when single > 1 s the ~64-70 ms
+            # dispatch floor is noise, amortized ~= single, and one
+            # burst suffices
+            burst_reps = (reps if float(np.median(singles)) < 1000.0
+                          else 1)
+            for _ in range(burst_reps):
                 t0 = time.perf_counter()
                 o = None
                 for _ in range(burst_n):
@@ -454,18 +469,23 @@ def main() -> None:
         parity-validated only in interpret/CPU mode — a wrong scatter
         hint or Mosaic lowering on real TPU would produce silently
         wrong results that the timing ladder would happily measure.
-        Before any config A/B is trusted, compare exact per-row
-        avalanche digests (mesh.replica_digest-style mixing — a plain
-        linear weighted sum was observed cancelling compensating errors
-        into collisions) of the FULL batch under the pinned
-        XLA-baseline ``cfg_a`` (NOT the shipped default, which becomes
-        suspect-vs-suspect the moment a pallas win lands in
-        switches.TPU_DEFAULTS) against ``cfg_b``. Requires a
+        Before any config A/B is trusted, compare the v5 family's
+        scalar — which IS an exact order-independent avalanche digest
+        of (rank, visibility, lane, conflict) per benchgen
+        .merge_wave_scalar (a plain linear weighted sum was observed
+        cancelling compensating errors into collisions) — of the FULL
+        batch under the pinned XLA-baseline ``cfg_a`` (NOT the shipped
+        default, which becomes suspect-vs-suspect the moment a win
+        lands in switches.TPU_DEFAULTS) against ``cfg_b``. Riding the
+        SAME compiled program as the timing items is the round-5
+        window-economy fix: the previous separate per-row digest
+        program cost two fresh compiles and ate two whole windows
+        mid-compile; now the baseline digest is a dispatch of an
+        already-compiled program and the candidate digest shares its
+        compile with the candidate's own bench item. Requires a
         bench-validated v5 budget (same precondition as stages_item:
         truncated programs clamp identically and would certify a false
         MATCH); done only on MATCH with zero overflow on both sides."""
-        from cause_tpu.weaver.jaxw5 import batched_merge_weave_v5
-
         if mosaic_gate(name, kernel_b, cfg_b):
             return
         if "v5" not in validated_k:
@@ -478,52 +498,17 @@ def main() -> None:
 
         def digests(kernel, cfg):
             set_config(cfg)
-            if kernel == "v5f":
-                from cause_tpu.weaver.jaxw5f import (
-                    batched_merge_weave_v5f)
-
-                def run_kernel(*a):
-                    return batched_merge_weave_v5f(
-                        *a, u_max=k, k_max=k)
-            else:
-                euler = "walk" if kernel == "v5w" else "doubling"
-
-                def run_kernel(*a):
-                    return batched_merge_weave_v5(
-                        *a, u_max=k, k_max=k, euler=euler)
-
-            @jax.jit
-            def prog(*a):
-                rank, vis, conflict, ovf = run_kernel(*a)
-                lane = jax.lax.broadcasted_iota(
-                    jnp.uint32, rank.shape, 1)
-                x = (rank.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
-                     + vis.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
-                     + lane * jnp.uint32(0xC2B2AE35)
-                     + jnp.uint32(1))
-                x = x ^ (x >> 16)
-                x = x * jnp.uint32(0x85EBCA6B)
-                x = x ^ (x >> 13)
-                x = x * jnp.uint32(0xC2B2AE35)
-                x = x ^ (x >> 16)
-                # conflict is a per-row output too — a strategy wrong
-                # only in conflict must not certify MATCH
-                return (jnp.sum(x, axis=1)
-                        ^ (conflict.astype(jnp.uint32)
-                           * jnp.uint32(0x27D4EB2F)),
-                        jnp.sum(ovf.astype(jnp.int32)))
-
-            out = prog(*[dev[n] for n in LANE_KEYS5])
-            return tuple(np.asarray(x) for x in out)
+            out = np.asarray(dispatch(kernel, k))
+            return int(out[0]), int(out[1])
 
         try:
             da, ova = digests("v5", cfg_a)
             db, ovb = digests(kernel_b, cfg_b)
-            mism = int(np.sum(da != db))
-            ok = mism == 0 and ova == 0 and ovb == 0
-            emit(ev="result", item=name, mismatch_rows=mism,
+            ok = da == db and ova == 0 and ovb == 0
+            emit(ev="result", item=name,
+                 digest_a=da, digest_b=db,
                  overflow_a=int(ova), overflow_b=int(ovb),
-                 rows=int(da.shape[0]), platform=plat,
+                 platform=plat,
                  verdict="MATCH" if ok else "MISMATCH")
             if ok:
                 if record_state:
@@ -559,11 +544,11 @@ def main() -> None:
                 singles.append(("v5f", dict(cfg_a), "kernel=v5f"))
             for kern, cfg1, val in singles:
                 d1, ov1 = digests(kern, cfg1)
-                m1 = int(np.sum(da != d1))
+                m1 = int(da != d1)
                 if m1 or ov1 != ova:
                     suspect_values.add(val)
                 emit(ev="verify_attr", item=name, strategy=val,
-                     mismatch_rows=m1, overflow=int(ov1),
+                     mismatch=m1, overflow=int(ov1),
                      platform=plat)
             if not (suspect_values - pre_suspects):
                 # combination-only defect: no single strategy
@@ -594,11 +579,11 @@ def main() -> None:
                 if (reduced != cfg_b
                         and any(v != "xla" for v in reduced.values())):
                     dr, ovr = digests("v5", reduced)
-                    mr = int(np.sum(da != dr))
-                    okr = mr == 0 and ova == 0 and ovr == 0
-                    emit(ev="result", item=name, mismatch_rows=mr,
+                    okr = da == dr and ova == 0 and ovr == 0
+                    emit(ev="result", item=name,
+                         digest_a=da, digest_b=dr,
                          overflow_a=int(ova), overflow_b=int(ovr),
-                         rows=int(da.shape[0]), platform=plat,
+                         platform=plat,
                          verdict=("MATCH-REDUCED" if okr
                                   else "MISMATCH-REDUCED"),
                          cfg=flips_of(reduced))
@@ -978,6 +963,16 @@ def decide_defaults(done: set, results: dict, plat: str,
              reason="no v5-certified same-window config beat the xla "
                     f"baseline by >2% (base {base} ms, "
                     f"beststream {p50} ms, same_window={same_window})")
+        return
+    # the timed cfg and the digest-certified cfg must be the SAME
+    # program (reduced-certification coherence: a bench record from
+    # before a reduction, or any future ladder reorder, must not ship
+    # switches the gate never checked)
+    vcfg = (results.get("verify_beststream") or {}).get("cfg")
+    if vcfg is not None and dict(vcfg) != dict(cand.get("cfg") or vcfg):
+        emit(ev="defaults", flipped=False,
+             reason=f"timed cfg {cand.get('cfg')} != certified cfg "
+                    f"{vcfg}; not shipping an uncertified combination")
         return
     # flip exactly what was timed: the bench record carries its own
     # cfg (reduced-certification support); the constant is only the

@@ -141,6 +141,54 @@ def test_certified_env_prefers_state_cfg(tmp_path, monkeypatch):
     assert h.certified_env() == want
 
 
+def test_cfgless_certification_forces_reverify(tmp_path, monkeypatch):
+    """A verify_beststream 'done' whose record carries no cfg (written
+    by code predating the cfg field) must not survive load: the static
+    BESTSTREAM may have gained strategies since, and acting on the old
+    verdict would time/ship a combination it never checked."""
+    h = _harvest()
+    p = tmp_path / "state.json"
+    p.write_text(json.dumps({
+        "version": h.STATE_VERSION,
+        "done": ["verify_beststream", "fleet64"],
+        "results": {},
+    }))
+    monkeypatch.setattr(h, "STATE_PATH", str(p))
+    done, _ = h.load_state()
+    assert "verify_beststream" not in done and "fleet64" in done
+    # with a cfg-bearing record it survives
+    p.write_text(json.dumps({
+        "version": h.STATE_VERSION,
+        "done": ["verify_beststream"],
+        "results": {"verify_beststream": {
+            "verdict": "MATCH", "cfg": {"CAUSE_TPU_GATHER": "rowgather"}}},
+    }))
+    done, _ = h.load_state()
+    assert "verify_beststream" in done
+
+
+def test_decide_requires_timed_cfg_to_match_certified_cfg(tmp_path):
+    """A bench_beststream record whose cfg differs from what the
+    digest gate certified (e.g. timed before a reduction) must not
+    flip defaults."""
+    h = _harvest()
+    path = str(tmp_path / "d.json")
+    results = _results(bench_xla_base=3750.0, bench_beststream=3000.0)
+    results["bench_beststream"]["cfg"] = dict(h.flips_of(h.BESTSTREAM))
+    results["verify_beststream"] = {
+        "verdict": "MATCH-REDUCED",
+        "cfg": {"CAUSE_TPU_GATHER": "rowgather"}}
+    h.decide_defaults(done={"verify_beststream"}, results=results,
+                      plat="tpu", path=path)
+    assert not os.path.exists(path)
+    # agreement -> flips the certified/timed cfg
+    results["bench_beststream"]["cfg"] = {"CAUSE_TPU_GATHER": "rowgather"}
+    h.decide_defaults(done={"verify_beststream"}, results=results,
+                      plat="tpu", path=path)
+    rec = json.loads(open(path).read())
+    assert rec["switches"] == {"CAUSE_TPU_GATHER": "rowgather"}
+
+
 def test_persisted_suspects_reseed_from_reduced_record():
     """A MATCH-REDUCED certification puts verify_beststream in done,
     so later windows run no suspect re-derivation — the dropped
@@ -260,7 +308,9 @@ def test_shipped_defaults_recertify_every_window(tmp_path, monkeypatch):
     p.write_text(json.dumps({
         "version": h.STATE_VERSION,
         "done": ["verify_beststream", "fleet64"],
-        "results": {},
+        "results": {"verify_beststream": {
+            "verdict": "MATCH",
+            "cfg": {"CAUSE_TPU_GATHER": "rowgather"}}},
     }))
     monkeypatch.setattr(h, "STATE_PATH", str(p))
     d = tmp_path / "_tpu_defaults.json"

@@ -71,6 +71,48 @@ def test_sort_pairs_env_switch(monkeypatch):
         assert np.array_equal(np.asarray(d), np.asarray(f))
 
 
+def test_v5_scalar_digest_config_independent(monkeypatch):
+    """merge_wave_scalar's v5 scalar is an exact avalanche digest:
+    identical integers across strategy configs (it doubles as the
+    on-chip correctness gate), and sensitive to any weave change."""
+    import jax
+    import numpy as np
+
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5, merge_wave_scalar
+
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=2, n_base=30, n_div=8, capacity=64, hide_every=3
+    )
+    v5b = benchgen.batched_v5_inputs(batch, 64)
+    args = [v5b[k] for k in LANE_KEYS5]
+    k = benchgen.v5_token_budget(v5b)
+
+    def digest():
+        out = np.asarray(
+            merge_wave_scalar(*args, k_max=k, kernel="v5", u_max=k))
+        assert out.dtype == np.int32 and out[1] == 0
+        return int(out[0])
+
+    base = digest()
+    for mode in ("matrix", "bitonic"):
+        jax.clear_caches()
+        monkeypatch.setenv("CAUSE_TPU_SORT", mode)
+        assert digest() == base, mode
+        monkeypatch.delenv("CAUSE_TPU_SORT")
+    jax.clear_caches()
+    # sensitivity: dropping one divergent lane changes the digest
+    mutated = dict(v5b)
+    valid = np.array(v5b["valid"]).copy()
+    row0_last = int(np.max(np.nonzero(valid[0])[0]))
+    valid[0, row0_last] = False
+    mutated["valid"] = valid
+    margs = [mutated[k_] for k_ in LANE_KEYS5]
+    out = np.asarray(
+        merge_wave_scalar(*margs, k_max=k, kernel="v5", u_max=k))
+    assert int(out[0]) != base
+
+
 def test_v5_kernel_parity_under_matrix_sort(monkeypatch):
     """The full batched v5 merge is bit-exact with every sort routed
     through the matrix strategy (the digest gate's CPU rehearsal)."""
