@@ -36,6 +36,7 @@ import subprocess
 import sys
 import time
 
+from cause_tpu import obs  # dependency-light (no jax), like switches
 from cause_tpu.switches import TRACE_SWITCHES  # dependency-free
 
 NORTH_STAR_MS = 100.0
@@ -114,6 +115,21 @@ def _run_abandonable(cmd, env, deadline_s, sentinel=None,
     return None
 
 
+def _export_obs_trace(obs_out: str) -> None:
+    """Convert the run's obs sidecar (parent + children appends) into
+    a Perfetto-openable trace next to it. Best-effort: a trace export
+    failure must never cost the bench artifact."""
+    if not obs_out or not os.path.exists(obs_out):
+        return
+    try:
+        n = obs.export_perfetto(obs_out + ".perfetto.json",
+                                jsonl=obs_out)
+        print(f"bench: perfetto trace -> {obs_out}.perfetto.json "
+              f"({n} events)", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - best-effort export
+        print(f"bench: perfetto export failed ({e})", file=sys.stderr)
+
+
 class _Overflow(RuntimeError):
     pass
 
@@ -126,6 +142,31 @@ def _timed_once(step, k_max, kernel) -> float:
 
 def _flag(name: str) -> bool:
     return os.environ.get(name, "").strip() in ("1", "true", "yes")
+
+
+def _checksum_gate(default_ck, alt_ck, certified: bool) -> bool:
+    """The alt-config correctness gate's DECISION (pure, unit-tested):
+    returns True when the checksums deviate beyond tolerance.
+
+    Asymmetry by design (ADVICE r5 low #3): in the UNCERTIFIED branch
+    the already-timed default is the XLA program and the alt is the
+    untrusted candidate, so a deviation refuses the alt (raise —
+    never time a possibly-wrong program). In the CERTIFIED branch the
+    roles invert — the already-timed default is the certified config
+    and the alt IS the XLA baseline — so the deviation indicts the
+    certified program: return True and let the caller publish the
+    baseline's timing and tag the artifact ``checksum_deviation``."""
+    if default_ck is None or alt_ck is None:
+        return False
+    denom = max(abs(default_ck), 1.0)
+    if abs(alt_ck - default_ck) / denom <= 1e-3:
+        return False
+    if not certified:
+        raise RuntimeError(
+            f"alt checksum {alt_ck!r} deviates from default "
+            f"{default_ck!r}; refusing to time a possibly-wrong "
+            "program")
+    return True
 
 
 def measure(platform: str) -> dict:
@@ -164,13 +205,14 @@ def measure(platform: str) -> dict:
         # (tombstones every 8th suffix node), 1024 replica pairs.
         B, n_base, n_div, cap, reps = 1024, 9_000, 1_000, 10_240, 3
 
-    batch = benchgen.batched_pair_lanes(
-        n_replicas=B, n_base=n_base, n_div=n_div, capacity=cap,
-        hide_every=8
-    )
-    v5batch = benchgen.batched_v5_inputs(batch, cap)
-    budget = benchgen.pair_run_budget(batch)
-    u_budget = benchgen.v5_token_budget(v5batch)
+    with obs.span("bench.marshal", B=B, smoke=smoke):
+        batch = benchgen.batched_pair_lanes(
+            n_replicas=B, n_base=n_base, n_div=n_div, capacity=cap,
+            hide_every=8
+        )
+        v5batch = benchgen.batched_v5_inputs(batch, cap)
+        budget = benchgen.pair_run_budget(batch)
+        u_budget = benchgen.v5_token_budget(v5batch)
 
     if platform != "cpu":
         # persistent compile cache: the 1024x20k kernels cost tens of
@@ -181,6 +223,7 @@ def measure(platform: str) -> dict:
         enable_compile_cache()
 
     real_platform = jax.devices()[0].platform
+    obs.set_platform(real_platform)
     # BENCH_SENTINEL protocol: tell the parent the backend answered, so
     # it can extend this child's deadline from probe-scale to full-scale
     # (one tunnel claim instead of a separate probe child + measure
@@ -203,13 +246,14 @@ def measure(platform: str) -> dict:
     # (shapes + batch marshalled above, before the backend claim; CPU
     # runs full size too — the honest fallback evidence when the
     # tunnel is down; BENCH_SMOKE=1 forces the tiny shape)
-    dev = {
-        k: jax.device_put(batch[k])
-        for k in dict.fromkeys(LANE_KEYS + LANE_KEYS4)
-    }
-    for k in LANE_KEYS5:
-        if k not in dev:
-            dev[k] = jax.device_put(v5batch[k])
+    with obs.span("bench.upload"):
+        dev = {
+            k: jax.device_put(batch[k])
+            for k in dict.fromkeys(LANE_KEYS + LANE_KEYS4)
+        }
+        for k in LANE_KEYS5:
+            if k not in dev:
+                dev[k] = jax.device_put(v5batch[k])
 
     def dispatch(k: int, kernel: str):
         lanes = (LANE_KEYS5 if kernel in ("v5", "v5w", "v5f")
@@ -285,26 +329,34 @@ def measure(platform: str) -> dict:
         fb = family[forced]
         ladder = [(fb, forced), (2 * fb, forced)] + ladder
     _bail_if_abandoned()
-    for k_max, kernel in ladder:
-        try:
-            step(k_max, kernel)
-            break
-        except _Overflow:
-            print(f"bench: run budget {k_max} ({kernel}) overflowed; "
-                  "retrying", file=sys.stderr)
+    with obs.span("bench.ladder"):
+        for k_max, kernel in ladder:
+            try:
+                with obs.span("bench.compile_warm", kernel=kernel,
+                              k_max=int(k_max)):
+                    step(k_max, kernel)
+                break
+            except _Overflow:
+                obs.event("bench.overflow", kernel=kernel,
+                          k_max=int(k_max))
+                print(f"bench: run budget {k_max} ({kernel}) "
+                      "overflowed; retrying", file=sys.stderr)
     _bail_if_abandoned()
-    p50_single = float(np.median(
-        [_timed_once(step, k_max, kernel) for _ in range(reps)]
-    ))
+    with obs.span("bench.single_dispatch", kernel=kernel, reps=reps):
+        p50_single = float(np.median(
+            [_timed_once(step, k_max, kernel) for _ in range(reps)]
+        ))
     # Window budget: a burst costs N_BURST * p50_single. When the
     # kernel is slow enough that the ~64-70 ms dispatch floor is noise
     # (<7% at 1 s), amortized ~= single and repeated bursts buy nothing
     # but tunnel time — one burst rep suffices. Near the target the
     # floor matters and the full rep count is kept.
     burst_reps = reps if p50_single < 1000.0 else 1
-    p50_amortized = float(np.median(
-        [burst(k_max, kernel) for _ in range(burst_reps)]
-    ))
+    with obs.span("bench.burst", kernel=kernel, reps=burst_reps,
+                  waves=N_BURST):
+        p50_amortized = float(np.median(
+            [burst(k_max, kernel) for _ in range(burst_reps)]
+        ))
 
     # On real hardware, also try ONE alternative configuration and
     # keep whichever is faster. With chip-certified defaults on disk
@@ -332,6 +384,7 @@ def measure(platform: str) -> dict:
                 and not _flag("BENCH_NO_ALLSTREAM")
                 and not preset)
     alt = None
+    checksum_deviation = False
     _bail_if_abandoned()
     if want_alt:
         from cause_tpu.switches import TPU_DEFAULTS as _certified
@@ -365,7 +418,8 @@ def measure(platform: str) -> dict:
         jax.clear_caches()
         try:
             default_ck = last_ck[0]
-            step(k_max, kernel)  # compile + overflow check
+            with obs.span("bench.alt_compile", config=alt_label):
+                step(k_max, kernel)  # compile + overflow check
             # correctness gate on the UNGATED self-selection path
             # (harvest's digest gate is the real certifier). For the
             # v5 family the scalar is an exact order-independent
@@ -373,22 +427,49 @@ def measure(platform: str) -> dict:
             # deviation; the tolerance only matters for the v1-v4
             # fallback kernels whose scalar is still a float sum with
             # reduction-order drift between differently-fused programs
-            if default_ck is not None and last_ck[0] is not None:
-                denom = max(abs(default_ck), 1.0)
-                if abs(last_ck[0] - default_ck) / denom > 1e-3:
-                    raise RuntimeError(
-                        f"alt checksum {last_ck[0]!r} deviates from "
-                        f"default {default_ck!r}; refusing to time a "
-                        "possibly-wrong program")
-            alt_single = float(np.median(
-                [_timed_once(step, k_max, kernel) for _ in range(reps)]
-            ))
-            alt_burst_reps = reps if alt_single < 1000.0 else 1
-            alt_amortized = float(np.median(
-                [burst(k_max, kernel) for _ in range(alt_burst_reps)]
-            ))
-            # swap only now: every alt measurement succeeded
-            if alt_amortized < p50_amortized:
+            try:
+                checksum_deviation = _checksum_gate(
+                    default_ck, last_ck[0], bool(_certified))
+            except RuntimeError:
+                # uncertified branch refusal: gate outcome still lands
+                # in the trace before the generic keep-default handler
+                obs.event("bench.checksum_gate", outcome="deviation",
+                          config=alt_label, default_ck=default_ck,
+                          alt_ck=last_ck[0], certified=False)
+                obs.counter("bench.checksum_gate.deviation").inc()
+                raise
+            obs.event(
+                "bench.checksum_gate",
+                outcome="deviation" if checksum_deviation else "match",
+                config=alt_label, default_ck=default_ck,
+                alt_ck=last_ck[0], certified=bool(_certified))
+            obs.counter(
+                "bench.checksum_gate."
+                + ("deviation" if checksum_deviation else "match")
+            ).inc()
+            if checksum_deviation:
+                # certified-defaults branch: see _checksum_gate —
+                # publish the baseline's timing instead of silently
+                # keeping the suspect certified result, and tag the
+                # artifact either way
+                print("bench: checksum deviation under certified "
+                      f"defaults (default {default_ck!r} vs "
+                      f"baseline {last_ck[0]!r}); preferring the "
+                      "XLA baseline timing", file=sys.stderr)
+            with obs.span("bench.alt_measure", config=alt_label):
+                alt_single = float(np.median(
+                    [_timed_once(step, k_max, kernel)
+                     for _ in range(reps)]
+                ))
+                alt_burst_reps = reps if alt_single < 1000.0 else 1
+                alt_amortized = float(np.median(
+                    [burst(k_max, kernel)
+                     for _ in range(alt_burst_reps)]
+                ))
+            # swap only now: every alt measurement succeeded. A
+            # checksum deviation in the certified branch forces the
+            # swap — the suspect certified timing must not headline
+            if alt_amortized < p50_amortized or checksum_deviation:
                 config = alt_label
                 alt = p50_amortized
                 p50_amortized = alt_amortized
@@ -439,6 +520,12 @@ def measure(platform: str) -> dict:
     }
     if alt is not None:
         out["other_config_ms"] = round(alt, 3)
+    if checksum_deviation:
+        # the deviation is evidence against the certified program; the
+        # artifact must carry it even when the baseline timing could
+        # not be published (alt measurement failure kept the default)
+        out["checksum_deviation"] = True
+    obs.flush()  # program-cache + gate counters into the sidecar
     return out
 
 
@@ -449,6 +536,24 @@ def main() -> None:
         # failure propagate — the parent handles it
         print(json.dumps(measure(child_platform)))
         return
+
+    # With obs on but no explicit sink, default to a sidecar next to
+    # the measurements so `CAUSE_TPU_OBS=1 python bench.py` yields a
+    # trace with zero extra flags. Children inherit the path through
+    # the environment and APPEND (atomic line writes), so an abandoned
+    # child's events still land; obs stays a no-op when CAUSE_TPU_OBS
+    # is unset — the bench output is byte-identical then.
+    obs_out = ""
+    if obs.enabled():
+        obs_out = os.environ.get("CAUSE_TPU_OBS_OUT", "").strip()
+        if not obs_out:
+            obs_out = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "measurements",
+                f"obs_bench_{int(time.time())}.jsonl")
+            os.environ["CAUSE_TPU_OBS_OUT"] = obs_out
+            obs.configure(out=obs_out)
+        print(f"bench: obs events -> {obs_out}", file=sys.stderr)
 
     force_cpu = _flag("BENCH_FORCE_CPU")
     # an explicitly requested CPU run is "cpu-forced"; "cpu-fallback"
@@ -523,6 +628,7 @@ def main() -> None:
         out = out.strip()
         if rc == 0 and out:
             print(out.splitlines()[-1])
+            _export_obs_trace(obs_out)
             return
         tail = (err or "").strip().splitlines()[-1:] or ["?"]
         errors.append(f"{platform}: rc={rc} {tail[0][:200]}")
@@ -537,6 +643,7 @@ def main() -> None:
         "platform": "none",
         "error": "; ".join(errors)[:500],
     }))
+    _export_obs_trace(obs_out)
 
 
 if __name__ == "__main__":

@@ -206,12 +206,19 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
     # "xla"-onto-unset collapse for non-defaulted switches; the
     # mapping lives in switches.py next to resolve() so key and
     # trace-time resolution cannot drift.
+    from .obs import counter as _obs_counter, span as _obs_span
     from .switches import TRACE_SWITCHES, raw_key
 
     switches = tuple(raw_key(k) for k in TRACE_SWITCHES)
     key = (k_max, kernel if k_max > 0 else "v1", u_max, switches)
     program = _scalar_programs.get(key)
     if program is None:
+        # program-cache provenance: every miss is a fresh trace (and on
+        # TPU a fresh XLA compile) keyed by the raw switch snapshot —
+        # the counters make silent re-trace storms visible in any obs
+        # trace (obs never feeds back into ``key``: the identity
+        # contract is one-way)
+        _obs_counter("program_cache.miss").inc()
         import functools
 
         import jax
@@ -307,6 +314,10 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
                 return _checksum(*jax.vmap(merge_weave_kernel)(*a))
 
         _scalar_programs[key] = program
+        with _obs_span("program.build", kernel=key[1],
+                       k_max=int(k_max), u_max=int(u_max)):
+            return program(*args)
+    _obs_counter("program_cache.hit").inc()
     return program(*args)
 
 def v5_inputs(row: Dict[str, np.ndarray], capacity: int,

@@ -1,0 +1,42 @@
+"""cause_tpu.obs — the unified trace/metrics subsystem.
+
+Spans, counters/gauges, a bounded event ring with JSONL streaming, and
+a Chrome-trace/Perfetto exporter. Importable without jax (like
+``switches.py``); a no-op unless ``CAUSE_TPU_OBS=1``. See
+``core.py``'s module docstring for the full contract and
+``python -m cause_tpu.obs --help`` for the trace converter.
+"""
+
+from .core import (
+    configure,
+    counter,
+    counters_snapshot,
+    enabled,
+    event,
+    events,
+    export_jsonl,
+    flush,
+    gauge,
+    reset,
+    set_platform,
+    span,
+)
+from .perfetto import export_perfetto, load_jsonl, to_chrome_trace
+
+__all__ = [
+    "configure",
+    "counter",
+    "counters_snapshot",
+    "enabled",
+    "event",
+    "events",
+    "export_jsonl",
+    "export_perfetto",
+    "flush",
+    "gauge",
+    "load_jsonl",
+    "reset",
+    "set_platform",
+    "span",
+    "to_chrome_trace",
+]

@@ -1,0 +1,69 @@
+"""CLI: convert an obs JSONL event stream to a Perfetto trace.
+
+    python -m cause_tpu.obs events.jsonl -o trace.json
+
+Open the output at https://ui.perfetto.dev (or chrome://tracing).
+With ``--summary`` it also prints per-span-name aggregate wall times
+and the final counter values — the quick look before reaching for the
+viewer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .perfetto import export_perfetto, load_jsonl
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cause_tpu.obs",
+        description="Convert obs JSONL events to a Perfetto/Chrome "
+                    "trace (and/or print a summary).")
+    ap.add_argument("jsonl", help="obs event file (JSON lines)")
+    ap.add_argument("-o", "--out", default="",
+                    help="write the Perfetto trace JSON here "
+                         "(default: <jsonl>.perfetto.json)")
+    ap.add_argument("--summary", action="store_true",
+                    help="print per-span aggregates and counters")
+    a = ap.parse_args(argv)
+
+    events = load_jsonl(a.jsonl)
+    out = a.out or (a.jsonl + ".perfetto.json")
+    n = export_perfetto(out, events=events)
+    print(f"{out}: {n} trace events from {len(events)} records",
+          file=sys.stderr)
+
+    if a.summary:
+        agg: dict = {}
+        # counter snapshots are cumulative PER PROCESS: keep each
+        # pid's last snapshot and sum across pids (a shared sidecar
+        # interleaves parent + abandoned-child flushes — last-wins
+        # across pids would report whichever process flushed last)
+        per_pid: dict = {}
+        for e in events:
+            if e.get("ev") == "span":
+                name = e.get("name", "?")
+                tot, cnt = agg.get(name, (0, 0))
+                agg[name] = (tot + e.get("dur_us", 0), cnt + 1)
+            elif e.get("ev") == "counters":
+                merged = dict(e.get("counters") or {})
+                merged.update(e.get("gauges") or {})
+                per_pid[e.get("pid", 0)] = merged
+        counters: dict = {}
+        for snap in per_pid.values():
+            for name, value in snap.items():
+                counters[name] = counters.get(name, 0) + value
+        for name in sorted(agg, key=lambda n_: -agg[n_][0]):
+            tot, cnt = agg[name]
+            print(json.dumps({"span": name, "total_ms":
+                              round(tot / 1000.0, 3), "count": cnt}))
+        if counters:
+            print(json.dumps({"counters": counters}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

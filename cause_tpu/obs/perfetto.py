@@ -1,0 +1,126 @@
+"""Chrome-trace/Perfetto export of obs event streams.
+
+Converts the JSONL event schema of ``cause_tpu.obs.core`` into the
+Chrome Trace Event JSON format that https://ui.perfetto.dev (and
+chrome://tracing) open directly:
+
+- ``span`` events become complete ("ph": "X") slices on a
+  per-process/per-thread track, with the span attributes AND the
+  ``TRACE_SWITCHES`` program-identity snapshot as args;
+- ``event`` records become instant events ("ph": "i", thread scope);
+- ``counters`` snapshots become one counter track per metric
+  ("ph": "C"), so program-cache hit/miss rates and fallback counts
+  plot as time series next to the spans they explain.
+
+Stdlib-only, like the rest of ``cause_tpu.obs``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+__all__ = ["to_chrome_trace", "export_perfetto", "load_jsonl"]
+
+
+def load_jsonl(path: str) -> List[dict]:
+    """Parse an obs JSONL file (skipping any torn/garbage lines — an
+    abandoned writer may have lost the race with process death)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                out.append(obj)
+    return out
+
+
+def _args_of(e: dict) -> dict:
+    args = {}
+    for k, v in (e.get("attrs") or {}).items():
+        args[k] = v
+    for k, v in (e.get("switches") or {}).items():
+        args[k] = v
+    if e.get("platform"):
+        args["platform"] = e["platform"]
+    return args
+
+
+def to_chrome_trace(events: Iterable[dict]) -> dict:
+    """The Chrome Trace Event envelope for an obs event stream."""
+    trace: List[dict] = []
+    pids = set()
+    for e in events:
+        ev = e.get("ev")
+        pid = e.get("pid", 0)
+        pids.add(pid)
+        if ev == "span":
+            trace.append({
+                "name": e.get("name", "?"),
+                "cat": "obs",
+                "ph": "X",
+                "ts": e.get("ts_us", 0),
+                "dur": max(1, e.get("dur_us", 1)),
+                "pid": pid,
+                "tid": e.get("tid", 0),
+                "args": _args_of(e),
+            })
+        elif ev == "event":
+            args = dict(e.get("fields") or {})
+            if e.get("platform"):
+                args.setdefault("platform", e["platform"])
+            trace.append({
+                "name": e.get("name", "?"),
+                "cat": "obs",
+                "ph": "i",
+                "s": "t",
+                "ts": e.get("ts_us", 0),
+                "pid": pid,
+                "tid": e.get("tid", 0),
+                "args": args,
+            })
+        elif ev == "counters":
+            ts = e.get("ts_us", 0)
+            merged = dict(e.get("counters") or {})
+            merged.update(e.get("gauges") or {})
+            for name, value in sorted(merged.items()):
+                trace.append({
+                    "name": name,
+                    "cat": "obs",
+                    "ph": "C",
+                    "ts": ts,
+                    "pid": pid,
+                    "args": {"value": value},
+                })
+    for pid in sorted(pids):
+        trace.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": pid,
+            "args": {"name": f"cause_tpu pid {pid}"},
+        })
+    return {"traceEvents": trace, "displayTimeUnit": "ms"}
+
+
+def export_perfetto(path: str, events: Optional[Iterable[dict]] = None,
+                    jsonl: Optional[str] = None) -> int:
+    """Write a Perfetto-openable trace JSON to ``path`` from either an
+    in-memory event list, a JSONL file, or (default) the live ring
+    buffer. Returns the number of trace events written."""
+    if events is None:
+        if jsonl is not None:
+            events = load_jsonl(jsonl)
+        else:
+            from .core import events as _ring
+
+            events = _ring()
+    doc = to_chrome_trace(events)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return len(doc["traceEvents"])

@@ -36,6 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..collections import shared as s
 from ..weaver import lanecache
 from ..weaver.arrays import next_pow2
@@ -137,6 +138,11 @@ class FleetSession:
         return views
 
     def _full_upload(self, pairs):
+        with obs.span("session.full_upload", pairs=len(pairs)):
+            obs.counter("session.full_upload").inc()
+            return self._full_upload_inner(pairs)
+
+    def _full_upload_inner(self, pairs):
         views = self._collect_views(pairs)
         if views is None:
             raise s.CausalError(
@@ -187,6 +193,10 @@ class FleetSession:
         else (dropped caches, oversized deltas, capacity growth) falls
         back to a full re-upload."""
         pairs = list(pairs)
+        with obs.span("session.update", pairs=len(pairs)):
+            return self._update_inner(pairs)
+
+    def _update_inner(self, pairs):
         if len(pairs) != len(self._views):
             return self._full_upload(pairs)
         views = self._collect_views(pairs)
@@ -243,6 +253,7 @@ class FleetSession:
         _bad = _sampled_body_spotcheck(views)
         if _bad:
             raise next(iter(_bad.values()))
+        obs.counter("session.delta_update").inc()
 
         for r, ((va, vb), _old) in enumerate(zip(views, self._views)):
             segs_a, segs_b = va.segments(), vb.segments()
@@ -306,15 +317,17 @@ class FleetSession:
         from ..benchgen import LANE_KEYS5
         from ..weaver.jaxw5 import batched_merge_weave_v5
 
-        r, v, _c, ov = batched_merge_weave_v5(
-            *(self.dev[k] for k in LANE_KEYS5),
-            u_max=self.u_max, k_max=self.u_max,
-        )
-        digest = _digest_fn()(self.dev["hi"], self.dev["lo"], r, v)
-        self.last_rank = r
-        self.last_visible = v
-        self.last_overflow = ov
-        out = np.asarray(digest)
+        with obs.span("session.wave", pairs=len(self.pairs),
+                      u_max=int(self.u_max)):
+            r, v, _c, ov = batched_merge_weave_v5(
+                *(self.dev[k] for k in LANE_KEYS5),
+                u_max=self.u_max, k_max=self.u_max,
+            )
+            digest = _digest_fn()(self.dev["hi"], self.dev["lo"], r, v)
+            self.last_rank = r
+            self.last_visible = v
+            self.last_overflow = ov
+            out = np.asarray(digest)
         if bool(np.asarray(ov).any()):
             raise s.CausalError(
                 "wave overflowed the session's token budget; raise "

@@ -34,6 +34,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import obs
 from ..collections import shared as s
 from ..weaver import lanecache
 from ..weaver.arrays import I32_MAX, next_pow2
@@ -332,6 +333,12 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
     pairs = list(pairs)
     if not pairs:
         raise s.CausalError("Nothing to merge.", {"causes": {"empty-fleet"}})
+    with obs.span("wave.merge", pairs=len(pairs),
+                  sharded=mesh is not None):
+        return _merge_wave(pairs, mesh, ctx)
+
+
+def _merge_wave(pairs, mesh, ctx) -> WaveResult:
     for a, b in pairs:
         s.check_mergeable(a.ct, b.ct)
 
@@ -383,6 +390,9 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
         live = [i for i, v in enumerate(views) if v is not None]
     if not live:
         B = len(pairs)
+        obs.counter("wave.pairs").inc(B)
+        obs.counter("wave.fallback").inc(len(fallback))
+        obs.counter("wave.poisoned").inc(len(poisoned))
         return WaveResult(pairs, views, 0,
                           np.zeros((B, 0), np.int32),
                           np.zeros((B, 0), bool),
@@ -398,7 +408,8 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
         # copies of the first live row and drop their outputs below
         pad_rows = (-len(live_views)) % mesh.size
         live_views = live_views + [live_views[0]] * pad_rows
-    lanes = _assemble_rows(live_views, cap, bufs=ctx)
+    with obs.span("wave.assemble", rows=len(live_views), cap=int(cap)):
+        lanes = _assemble_rows(live_views, cap, bufs=ctx)
 
     from ..benchgen import LANE_KEYS5, v5_token_budget
 
@@ -445,24 +456,27 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
     # program, so exact budgets would recompile on every wave whose
     # divergence shifted slightly
     u_max = next_pow2(v5_token_budget(lanes))
-    if mesh is not None:
-        from .mesh import sharded_merge_weave_v5
+    with obs.span("wave.dispatch", kernel=pipeline,
+                  rows=len(live_views), u_max=int(u_max),
+                  sharded=mesh is not None):
+        if mesh is not None:
+            from .mesh import sharded_merge_weave_v5
 
-        if pipeline == "v5w":
-            raise ValueError(
-                "BENCH_KERNEL=v5w has no sharded wave step; use "
-                "v5 or v5f under a mesh")
-        jl = {k: jnp.asarray(v) for k, v in lanes.items()}
-        rank, visible, overflow, digest, _tv, _nc, _n_ov = (
-            sharded_merge_weave_v5(mesh, jl, u_max=u_max,
-                                   k_max=u_max, pipeline=pipeline)
-        )
-        rank = np.asarray(rank)
-        visible = np.asarray(visible)
-        digest = np.asarray(digest)
-        overflow = np.asarray(overflow)
-    else:
-        rank, visible, digest, overflow = dispatch_v5(lanes, u_max)
+            if pipeline == "v5w":
+                raise ValueError(
+                    "BENCH_KERNEL=v5w has no sharded wave step; use "
+                    "v5 or v5f under a mesh")
+            jl = {k: jnp.asarray(v) for k, v in lanes.items()}
+            rank, visible, overflow, digest, _tv, _nc, _n_ov = (
+                sharded_merge_weave_v5(mesh, jl, u_max=u_max,
+                                       k_max=u_max, pipeline=pipeline)
+            )
+            rank = np.asarray(rank)
+            visible = np.asarray(visible)
+            digest = np.asarray(digest)
+            overflow = np.asarray(overflow)
+        else:
+            rank, visible, digest, overflow = dispatch_v5(lanes, u_max)
     if overflow.any():
         # the token budget samples rows; a spiky unsampled row can
         # overflow. Retry just those rows (unsharded — a handful of
@@ -470,8 +484,13 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
         # resorting to host merges. np.array: jax host buffers can be
         # read-only.
         rows = np.flatnonzero(overflow)
+        obs.counter("wave.overflow_retry").inc(len(rows))
+        obs.event("wave.overflow_retry", rows=len(rows),
+                  u_max=int(u_max))
         sub = {k: lanes[k][rows] for k in LANE_KEYS5}
-        r2, v2, d2, ov2 = dispatch_v5(sub, 2 * u_max)
+        with obs.span("wave.dispatch.retry", rows=len(rows),
+                      u_max=int(2 * u_max)):
+            r2, v2, d2, ov2 = dispatch_v5(sub, 2 * u_max)
         rank = np.array(rank)
         visible = np.array(visible)
         digest = np.array(digest)
@@ -501,6 +520,9 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
         full_vis[i] = visible[j]
         full_dig[i] = digest[j]
         dig_valid[i] = True
+    obs.counter("wave.pairs").inc(B)
+    obs.counter("wave.fallback").inc(len(fallback))
+    obs.counter("wave.poisoned").inc(len(poisoned))
     return WaveResult(pairs, views, cap, full_rank, full_vis, full_dig,
                       fallback, pipeline, dig_valid,
                       poisoned=poisoned)

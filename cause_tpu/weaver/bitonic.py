@@ -119,17 +119,20 @@ def sort_pairs(operands, num_keys: int = 1):
     -capable backend) or ``matrix`` (blocked O(n^2) rank counting +
     rowgather apply — pure-XLA streaming, weaver/matsort.py) for
     hardware A/B with no code change."""
+    from ..obs import span
     from ..switches import resolve
 
     mode = resolve("CAUSE_TPU_SORT")
-    if mode == "bitonic":
-        return bitonic_sort(operands, num_keys=num_keys)
-    if mode == "pallas":
-        from .pallas_sort import pallas_bitonic_sort
+    with span("weave.sort", strategy=mode or "xla",
+              width=int(operands[0].shape[-1]), n_ops=len(operands)):
+        if mode == "bitonic":
+            return bitonic_sort(operands, num_keys=num_keys)
+        if mode == "pallas":
+            from .pallas_sort import pallas_bitonic_sort
 
-        return pallas_bitonic_sort(operands, num_keys=num_keys)
-    if mode == "matrix":
-        from .matsort import matrix_sort
+            return pallas_bitonic_sort(operands, num_keys=num_keys)
+        if mode == "matrix":
+            from .matsort import matrix_sort
 
-        return matrix_sort(operands, num_keys=num_keys)
-    return lax.sort(tuple(operands), num_keys=num_keys)
+            return matrix_sort(operands, num_keys=num_keys)
+        return lax.sort(tuple(operands), num_keys=num_keys)

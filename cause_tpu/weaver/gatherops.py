@@ -57,11 +57,16 @@ def take1d(table, idx):
     """The kernels' gather from a full-width lane table: plain XLA
     gather by default, ``rowgather1d`` when
     ``CAUSE_TPU_GATHER=rowgather`` (trace-time switch)."""
+    from ..obs import span
     from ..switches import resolve
 
-    if resolve("CAUSE_TPU_GATHER") == "rowgather":
-        return rowgather1d(table, idx)
-    return table[idx]
+    mode = "rowgather" if resolve("CAUSE_TPU_GATHER") == "rowgather" \
+        else "xla"
+    with span("weave.gather", strategy=mode,
+              width=int(table.shape[-1])):
+        if mode == "rowgather":
+            return rowgather1d(table, idx)
+        return table[idx]
 
 
 def searchsorted_iota_right(keys_cum, q: int):
@@ -81,16 +86,21 @@ def searchsorted_iota_right(keys_cum, q: int):
     S-width table search in jaxw5 and leaves this histogram alone —
     that is what the combined beststream config uses until the
     microbench decides."""
+    from ..obs import span
     from ..switches import resolve
 
-    if resolve("CAUSE_TPU_SEARCH") == "matrix":
-        tgt = jnp.arange(q, dtype=keys_cum.dtype)
-        le = keys_cum[None, :] <= tgt[:, None]
-        return jnp.sum(le, axis=1).astype(jnp.int32)
-    hist = jnp.zeros(q + 1, jnp.int32).at[
-        jnp.clip(keys_cum, 0, q)
-    ].add(1, mode="drop")
-    return jnp.cumsum(hist[:q]).astype(jnp.int32)
+    mode = "matrix" if resolve("CAUSE_TPU_SEARCH") == "matrix" \
+        else "histogram"
+    with span("weave.search", strategy=mode, site="iota_right",
+              q=int(q)):
+        if mode == "matrix":
+            tgt = jnp.arange(q, dtype=keys_cum.dtype)
+            le = keys_cum[None, :] <= tgt[:, None]
+            return jnp.sum(le, axis=1).astype(jnp.int32)
+        hist = jnp.zeros(q + 1, jnp.int32).at[
+            jnp.clip(keys_cum, 0, q)
+        ].add(1, mode="drop")
+        return jnp.cumsum(hist[:q]).astype(jnp.int32)
 
 
 def searchsorted_targets_left(keys_cum, k: int):

@@ -132,34 +132,43 @@ def _pair_search_le(kh, kl, qh, ql, size):
     (``matrix-table`` applies matrix search HERE only, leaving the
     U-width searchsorted histogram in gatherops untouched — see its
     docstring for why.)"""
-    if resolve("CAUSE_TPU_SEARCH") in ("matrix", "matrix-table"):
-        le = _le(kh[None, :], kl[None, :], qh[:, None], ql[:, None])
-        return jnp.sum(le, axis=1).astype(jnp.int32) - 1
+    from ..obs import span as _span
 
-    steps = 1
-    while (1 << steps) < size + 1:
-        steps += 1
+    mode = resolve("CAUSE_TPU_SEARCH")
+    if mode in ("matrix", "matrix-table"):
+        with _span("weave.search", strategy=mode, site="table",
+                   size=int(size)):
+            le = _le(kh[None, :], kl[None, :], qh[:, None], ql[:, None])
+            return jnp.sum(le, axis=1).astype(jnp.int32) - 1
 
-    def body(_, c):
-        lo_b, hi_b = c
-        mid = (lo_b + hi_b + 1) // 2  # invariant: key[lo_b] <= q
-        ms = jnp.clip(mid, 0, size - 1)
-        ok = _le(take1d(kh, ms), take1d(kl, ms), qh, ql)
-        return jnp.where(ok, mid, lo_b), jnp.where(ok, hi_b, mid - 1)
+    with _span("weave.search", strategy="binary", site="table",
+               size=int(size)):
+        steps = 1
+        while (1 << steps) < size + 1:
+            steps += 1
 
-    lo_b, _ = lax.fori_loop(
-        0, steps, body,
-        (jnp.full_like(qh, -1), jnp.full_like(qh, size - 1)),
-    )
-    return lo_b
+        def body(_, c):
+            lo_b, hi_b = c
+            mid = (lo_b + hi_b + 1) // 2  # invariant: key[lo_b] <= q
+            ms = jnp.clip(mid, 0, size - 1)
+            ok = _le(take1d(kh, ms), take1d(kl, ms), qh, ql)
+            return (jnp.where(ok, mid, lo_b),
+                    jnp.where(ok, hi_b, mid - 1))
+
+        lo_b, _ = lax.fori_loop(
+            0, steps, body,
+            (jnp.full_like(qh, -1), jnp.full_like(qh, size - 1)),
+        )
+        return lo_b
 
 
-def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
-                          sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
-                          sg_len, sg_lane0, sg_dense, sg_tail_special,
-                          sg_valid, sg_vsum, u_max: int, k_max: int,
-                          stage: str | None = None,
-                          euler: str = "doubling"):
+def _merge_weave_kernel_v5_impl(hi, lo, cci, vclass, valid, seg,
+                                sg_min_hi, sg_min_lo, sg_max_hi,
+                                sg_max_lo, sg_len, sg_lane0, sg_dense,
+                                sg_tail_special, sg_valid, sg_vsum,
+                                u_max: int, k_max: int,
+                                stage: str | None = None,
+                                euler: str = "doubling"):
     """Union + reweave at segment granularity for one replica set.
 
     Node lanes as in v4 (``hi/lo/cci/vclass/valid`` — trees
@@ -692,6 +701,31 @@ def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
     )
     overflow = overflow_u | overflow_k
     return rank_lane, visible, conflict, overflow
+
+
+def merge_weave_kernel_v5(hi, lo, cci, vclass, valid, seg,
+                          sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
+                          sg_len, sg_lane0, sg_dense, sg_tail_special,
+                          sg_valid, sg_vsum, u_max: int, k_max: int,
+                          stage: str | None = None,
+                          euler: str = "doubling"):
+    """The v5 segment-union kernel (see ``_merge_weave_kernel_v5_impl``
+    for the phase-by-phase contract), traced under an obs span so a
+    bench/harvest trace attributes host TRACE time — where the sort/
+    gather/search strategy spans nest — to the kernel build it
+    belongs to. Runs only at trace time (the body is jit-staged), so
+    the span cost never touches the dispatch path."""
+    from ..obs import span as _span
+
+    with _span("weave.trace.v5", n=int(hi.shape[-1]),
+               u_max=int(u_max), k_max=int(k_max),
+               stage=stage or "FULL", euler=euler):
+        return _merge_weave_kernel_v5_impl(
+            hi, lo, cci, vclass, valid, seg,
+            sg_min_hi, sg_min_lo, sg_max_hi, sg_max_lo,
+            sg_len, sg_lane0, sg_dense, sg_tail_special,
+            sg_valid, sg_vsum, u_max=u_max, k_max=k_max,
+            stage=stage, euler=euler)
 
 
 merge_weave_kernel_v5_jit = jax.jit(

@@ -55,6 +55,7 @@ from .arrays import (
     next_pow2,
 )
 from ..ids import is_id
+from ..obs import counter as _obs_counter
 
 __all__ = [
     "SharedInterner",
@@ -305,6 +306,7 @@ class LaneView:
         already built to skip re-masking the columns on a miss."""
         segs = self.arena.seg_cache.get(self.n)
         if segs is None:
+            _obs_counter("lanecache.segments.miss").inc()
             from .segments import tree_segments
 
             if na is None:
@@ -313,6 +315,8 @@ class LaneView:
             segs = tree_segments(hi, lo, na.cause_idx, na.vclass, na.n)
             with self.arena.lock:
                 _seg_cache_put(self.arena.seg_cache, self.n, segs)
+        else:
+            _obs_counter("lanecache.segments.hit").inc()
         return segs
 
 
@@ -377,6 +381,10 @@ def extend_view(view: Optional[LaneView], new_nodes) -> Optional[LaneView]:
     """
     if view is None:
         return None
+    # attempt/append counters: the gap between them is the bail rate
+    # (cache drops that force a lazy rebuild) — the signal the round-3
+    # incremental-marshal work exists to keep near zero
+    _obs_counter("lanecache.extend.attempt").inc()
     arena = view.arena
     interner = arena.interner
     arena.sync_ranks()  # a rank reassignment upgrades in place
@@ -467,6 +475,7 @@ def extend_view(view: Optional[LaneView], new_nodes) -> Optional[LaneView]:
             )
             if new_segs is not None:
                 _seg_cache_put(arena.seg_cache, n + k, new_segs)
+    _obs_counter("lanecache.extend.append").inc()
     return LaneView(arena, n + k)
 
 
@@ -494,10 +503,13 @@ def view_for(ct) -> Optional[LaneView]:
     if LIST_SHAPED is None:
         LIST_SHAPED = _list_shaped_types()
     if ct.type not in LIST_SHAPED:
+        _obs_counter("lanecache.view.unshaped").inc()
         return None
     view = getattr(ct, "lanes", None)
     if isinstance(view, LaneView) and view.n == len(ct.nodes):
+        _obs_counter("lanecache.view.hit").inc()
         return view
+    _obs_counter("lanecache.view.rebuild").inc()
     return build_view(ct.nodes, ct.uuid)
 
 

@@ -183,40 +183,44 @@ def pair_rows(pairs: Sequence[Tuple[dict, dict]],
     ``lanes`` the dict of [B, 2*cap] arrays (v4 LANE_KEYS4 layout),
     ``meta`` the per-row host artifacts for ``merged_map_weave``.
     """
-    trees = [t for pair in pairs for t in pair]
-    krank = key_table(trees)
-    interner = SiteInterner(
-        nid[1] for t in trees for nid in t
-    )
-    cap = next_pow2(max(
-        1 + len(krank) + len(t) for t in trees
-    ))
-    B = len(pairs)
-    N = 2 * cap
-    out = {
-        "hi": np.full((B, N), I32_MAX, np.int32),
-        "lo": np.full((B, N), I32_MAX, np.int32),
-        "cci": np.full((B, N), -1, np.int32),
-        "vc": np.zeros((B, N), np.int32),
-        "valid": np.zeros((B, N), bool),
-    }
-    meta = []
-    for r, (ta, tb) in enumerate(pairs):
-        row_meta = []
-        for t, nodes_map in enumerate((ta, tb)):
-            off = t * cap
-            hi, lo, cci, vc, valid, lane_nodes, lane_keys = forest_lanes(
-                nodes_map, krank, interner, cap, spec
-            )
-            sl = slice(off, off + cap)
-            out["hi"][r, sl] = hi
-            out["lo"][r, sl] = lo
-            out["cci"][r, sl] = np.where(cci >= 0, cci + off, -1)
-            out["vc"][r, sl] = vc
-            out["valid"][r, sl] = valid
-            row_meta.append((lane_nodes, lane_keys))
-        meta.append(row_meta)
-    return out, {"rows": meta, "capacity": cap, "key_rank": krank}
+    from ..obs import span as _span
+
+    with _span("mapw.pair_rows", pairs=len(pairs)):
+        trees = [t for pair in pairs for t in pair]
+        krank = key_table(trees)
+        interner = SiteInterner(
+            nid[1] for t in trees for nid in t
+        )
+        cap = next_pow2(max(
+            1 + len(krank) + len(t) for t in trees
+        ))
+        B = len(pairs)
+        N = 2 * cap
+        out = {
+            "hi": np.full((B, N), I32_MAX, np.int32),
+            "lo": np.full((B, N), I32_MAX, np.int32),
+            "cci": np.full((B, N), -1, np.int32),
+            "vc": np.zeros((B, N), np.int32),
+            "valid": np.zeros((B, N), bool),
+        }
+        meta = []
+        for r, (ta, tb) in enumerate(pairs):
+            row_meta = []
+            for t, nodes_map in enumerate((ta, tb)):
+                off = t * cap
+                (hi, lo, cci, vc, valid, lane_nodes,
+                 lane_keys) = forest_lanes(
+                    nodes_map, krank, interner, cap, spec
+                )
+                sl = slice(off, off + cap)
+                out["hi"][r, sl] = hi
+                out["lo"][r, sl] = lo
+                out["cci"][r, sl] = np.where(cci >= 0, cci + off, -1)
+                out["vc"][r, sl] = vc
+                out["valid"][r, sl] = valid
+                row_meta.append((lane_nodes, lane_keys))
+            meta.append(row_meta)
+        return out, {"rows": meta, "capacity": cap, "key_rank": krank}
 
 
 def batched_merge_map_weave(lanes: Dict[str, np.ndarray], k_max: int = 0):
@@ -448,11 +452,19 @@ def merge_map_wave(pairs, kernel: str = "v5") -> MapWaveResult:
     full node width, matching the list fleets) or "v4" (the original
     full-width forest route)."""
     from ..collections import shared as s
+    from ..obs import span as _span
 
     pairs = list(pairs)
     if not pairs:
         raise s.CausalError("Nothing to merge.",
                             {"causes": {"empty-fleet"}})
+    with _span("mapw.merge_wave", pairs=len(pairs), kernel=kernel):
+        return _merge_map_wave(pairs, kernel)
+
+
+def _merge_map_wave(pairs, kernel: str) -> MapWaveResult:
+    from ..collections import shared as s
+
     for a, b in pairs:
         s.check_mergeable(a.ct, b.ct)
         if a.ct.type != "map":

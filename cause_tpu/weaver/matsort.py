@@ -123,10 +123,14 @@ def matrix_sort(operands, num_keys: int = 1):
     """Stable last-axis lexicographic sort (see module docstring).
     Leading batch dimensions are flattened and vmapped — the blocked
     scans batch transparently."""
+    from ..obs import span
+
     operands = tuple(operands)
     shape = operands[0].shape
-    if len(shape) == 1:
-        return _matrix_sort_1d(operands, num_keys)
-    flat = [x.reshape((-1, shape[-1])) for x in operands]
-    out = jax.vmap(lambda *o: _matrix_sort_1d(o, num_keys))(*flat)
-    return tuple(x.reshape(shape) for x in out)
+    with span("weave.sort.matrix", width=int(shape[-1]),
+              n_ops=len(operands)):
+        if len(shape) == 1:
+            return _matrix_sort_1d(operands, num_keys)
+        flat = [x.reshape((-1, shape[-1])) for x in operands]
+        out = jax.vmap(lambda *o: _matrix_sort_1d(o, num_keys))(*flat)
+        return tuple(x.reshape(shape) for x in out)

@@ -64,6 +64,7 @@ STATE_PATH = os.path.join(
 # older definition must not certify a config that was never verified)
 STATE_VERSION = 2
 
+from cause_tpu import obs  # noqa: E402  (dependency-light, no jax)
 from cause_tpu.switches import TRACE_SWITCHES as SWITCHES  # noqa: E402
 
 # Every item pins the FULL switch set explicitly ("xla" = force the
@@ -143,6 +144,13 @@ def emit(**obj):
     obj["t"] = round(time.monotonic() - T0, 1)
     obj["utc"] = time.strftime("%H:%M:%S", time.gmtime())
     print(json.dumps(obj), flush=True)
+    # every ladder decision doubles as a structured obs event (no-op
+    # unless CAUSE_TPU_OBS/--obs-out is on): certify/revoke/skip lines
+    # carry the cfg and digests that justified them, so a soak log
+    # opens in Perfetto with full provenance instead of raw prints
+    obs.event("harvest." + str(obj.get("ev", "emit")),
+              **{k: v for k, v in obj.items()
+                 if k not in ("ev", "t", "utc")})
 
 
 def load_state() -> tuple:
@@ -205,7 +213,12 @@ def main() -> None:
     ap.add_argument("--allow-cpu", action="store_true",
                     help="run the ladder on the CPU backend (rehearsal)")
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--obs-out", default="",
+                    help="stream structured obs events (JSONL) to this"
+                         " path — future soak logs over raw prints")
     a = ap.parse_args()
+    if a.obs_out:
+        obs.configure(enabled=True, out=a.obs_out)
 
     # defend against stale switches inherited from a caller's env: every
     # measurement here names its config explicitly
@@ -258,6 +271,7 @@ def main() -> None:
     # ---- backend confirm (the blocking tunnel claim happens here) ----
     plat = jax.devices()[0].platform
     claim_disarm()  # BEFORE any compile can be in flight
+    obs.set_platform(plat)
     emit(ev="backend", platform=plat)
     if plat == "cpu" and not a.allow_cpu:
         emit(ev="abort", reason="cpu backend without --allow-cpu")
@@ -514,10 +528,12 @@ def main() -> None:
                 if record_state:
                     # the certified cfg rides the state so the timing
                     # item, decide_defaults and the watcher's phase-2
-                    # env all run EXACTLY what the digest gate checked
+                    # env all run EXACTLY what the digest gate checked;
+                    # the matched digest rides along so the provenance
+                    # of every later certify/ship decision is auditable
                     results[name] = dict(
                         item=name, verdict="MATCH",
-                        cfg=flips_of(cfg_b),
+                        cfg=flips_of(cfg_b), digest=int(da),
                         run=RUN_ID, platform=plat)
                     done.add(name)
                     save_state(done, results)
@@ -590,7 +606,7 @@ def main() -> None:
                     if okr and record_state:
                         results[name] = dict(
                             item=name, verdict="MATCH-REDUCED",
-                            cfg=flips_of(reduced),
+                            cfg=flips_of(reduced), digest=int(dr),
                             # the strategies the reduction dropped,
                             # persisted so later windows re-seed the
                             # suspect gate (see persisted_suspects)
@@ -841,7 +857,8 @@ def main() -> None:
             continue
         emit(ev="start", item=name)
         try:
-            fn(*args)
+            with obs.span("harvest.item", item=name):
+                fn(*args)
         except Exception as e:  # noqa: BLE001 - emit + try next item
             emit(ev="error", item=name,
                  error=f"{type(e).__name__}: {str(e)[:300]}")
@@ -872,17 +889,38 @@ def main() -> None:
     if record_state:
         decide_defaults(done, results, plat, suspects=suspect_values)
     emit(ev="done", complete=complete, platform=plat)
+    obs.flush()
 
 
 def certified_env() -> str:
     """Space-separated ``K=V`` pairs for the watcher's phase-2 wave
     run: the cfg the digest gate certified (full or reduced, from the
-    state file), falling back to the static BESTSTREAM flips when no
-    verify record carries one. Import-light on purpose — the watcher
-    calls this under JAX_PLATFORMS=cpu with the axon pool unset."""
+    state file). Import-light on purpose — the watcher calls this
+    under JAX_PLATFORMS=cpu with the axon pool unset.
+
+    Cfgless-certification guard (ADVICE r5 medium): when the RAW state
+    file claims verify_beststream (the watcher's grep on it is what
+    routed us here) but the record carries no cfg — a pre-migration
+    file, or a version-mismatched one load_state() discarded — return
+    the shipped-default sentinel (empty string) so the watcher takes
+    its shipped-default branch, mirroring load_state()'s cfgless
+    -record re-verify rule. The static BESTSTREAM flips (which now
+    include the never-before-certified matrix sort) are the fallback
+    ONLY when the state carries no verify_beststream claim at all."""
     _, results = load_state()
     stored = (results.get("verify_beststream") or {}).get("cfg")
-    flips = stored or flips_of(BESTSTREAM)
+    if stored:
+        return " ".join(f"{k}={v}" for k, v in sorted(stored.items()))
+    try:
+        with open(STATE_PATH) as f:
+            raw = json.load(f)
+        claimed = ("verify_beststream" in (raw.get("done") or ())
+                   or "verify_beststream" in (raw.get("results") or {}))
+    except Exception:  # noqa: BLE001 - missing/corrupt = no claim
+        claimed = False
+    if claimed:
+        return ""  # shipped-default sentinel: never ship uncertified
+    flips = flips_of(BESTSTREAM)
     return " ".join(f"{k}={v}" for k, v in sorted(flips.items()))
 
 
@@ -968,16 +1006,21 @@ def decide_defaults(done: set, results: dict, plat: str,
     # program (reduced-certification coherence: a bench record from
     # before a reduction, or any future ladder reorder, must not ship
     # switches the gate never checked)
-    vcfg = (results.get("verify_beststream") or {}).get("cfg")
+    vrec = results.get("verify_beststream") or {}
+    vcfg = vrec.get("cfg")
     if vcfg is not None and dict(vcfg) != dict(cand.get("cfg") or vcfg):
         emit(ev="defaults", flipped=False,
              reason=f"timed cfg {cand.get('cfg')} != certified cfg "
                     f"{vcfg}; not shipping an uncertified combination")
         return
     # flip exactly what was timed: the bench record carries its own
-    # cfg (reduced-certification support); the constant is only the
-    # fallback for records predating the cfg field
-    flips = dict(cand.get("cfg") or flips_of(BESTSTREAM))
+    # cfg (reduced-certification support). For records predating the
+    # cfg field the fallback is the CERTIFIED vcfg — not the static
+    # BESTSTREAM flips, which can differ from a reduced certification
+    # and would ship exactly the drift the coherence check above
+    # exists to prevent (ADVICE r5 low); the constant is the last
+    # resort only when neither record carries a cfg
+    flips = dict(cand.get("cfg") or vcfg or flips_of(BESTSTREAM))
     rec = {
         # committed on purpose: the framework targets exactly this
         # chip (v5e-1 behind the axon tunnel), and VERDICT r4 asks for
@@ -990,13 +1033,18 @@ def decide_defaults(done: set, results: dict, plat: str,
             "xla_base_ms": base,
             "run": cand.get("run"),
             "platform": plat,
+            # the digest the certification matched (None for records
+            # predating the field): the flip's provenance is auditable
+            # from the defaults file alone
+            "digest": vrec.get("digest"),
             "utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         },
     }
     with open(path, "w") as f:
         json.dump(rec, f, indent=1)
     emit(ev="defaults", flipped=True, p50_ms=p50, xla_base_ms=base,
-         kernel="v5", switches=flips, path=path)
+         kernel="v5", switches=flips, cfg=flips,
+         digest=vrec.get("digest"), path=path)
 
 
 if __name__ == "__main__":

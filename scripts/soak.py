@@ -323,10 +323,17 @@ ROUNDS = (list_round, wave_round, map_round, base_round, gc_round,
 
 
 def main():
+    from cause_tpu import obs
+
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=60.0)
     ap.add_argument("--seed0", type=int, default=0)
+    ap.add_argument("--obs-out", default="",
+                    help="stream structured obs events (JSONL) to "
+                         "this path instead of raw prints only")
     args = ap.parse_args()
+    if args.obs_out:
+        obs.configure(enabled=True, out=args.obs_out)
     deadline = time.monotonic() + args.minutes * 60
     seed = args.seed0
     done = 0
@@ -334,15 +341,21 @@ def main():
         rng = random.Random(seed)
         kind = ROUNDS[seed % len(ROUNDS)]
         try:
-            kind(rng)
+            with obs.span("soak.round", kind=kind.__name__, seed=seed):
+                kind(rng)
         except Exception as e:  # noqa: BLE001 - repro logging
+            obs.event("soak.failure", seed=seed, kind=kind.__name__,
+                      error=f"{type(e).__name__}: {e}")
+            obs.flush()
             print(f"SOAK FAILURE seed={seed} kind={kind.__name__}: "
                   f"{type(e).__name__}: {e}", flush=True)
             raise
         seed += 1
         done += 1
+        obs.counter("soak.rounds").inc()
         if done % 25 == 0:
             print(f"soak: {done} rounds clean (seed {seed})", flush=True)
+    obs.flush()
     print(f"soak finished: {done} rounds clean, no failures", flush=True)
 
 

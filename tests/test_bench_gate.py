@@ -1,0 +1,52 @@
+"""bench.py's alt-config checksum gate (ADVICE r5 low #3).
+
+The gate's decision is a pure function (bench._checksum_gate), so the
+asymmetry is pinned without spending a subprocess bench run: in the
+uncertified branch a deviation REFUSES the alt (the alt is the
+suspect); in the certified branch the already-timed default is the
+certified program and the alt is the XLA baseline, so a deviation
+returns True — the caller publishes the baseline timing and tags the
+artifact ``checksum_deviation`` instead of silently keeping the
+suspect certified result.
+"""
+
+import pytest
+
+import bench
+
+
+def test_match_passes_both_branches():
+    assert bench._checksum_gate(100.0, 100.0, certified=False) is False
+    assert bench._checksum_gate(100.0, 100.0, certified=True) is False
+    # inside tolerance (float-sum kernels drift in reduction order)
+    assert bench._checksum_gate(1e6, 1e6 * (1 + 5e-4),
+                                certified=True) is False
+
+
+def test_uncertified_deviation_refuses_the_alt():
+    with pytest.raises(RuntimeError, match="refusing to time"):
+        bench._checksum_gate(100.0, 250.0, certified=False)
+
+
+def test_certified_deviation_prefers_the_baseline():
+    """The deviation indicts the certified default, not the baseline:
+    no raise — the caller swaps to the baseline and tags the artifact."""
+    assert bench._checksum_gate(100.0, 250.0, certified=True) is True
+
+
+def test_missing_checksums_never_gate():
+    assert bench._checksum_gate(None, 250.0, certified=False) is False
+    assert bench._checksum_gate(100.0, None, certified=True) is False
+
+
+def test_measure_swaps_and_tags_on_certified_deviation():
+    """Source-level pin of the two consequences in measure(): the
+    forced swap (`or checksum_deviation`) and the artifact tag —
+    the pure gate above proves the decision, this proves it is wired
+    to the published headline."""
+    import os
+
+    src = open(os.path.join(os.path.dirname(bench.__file__)
+                            or ".", "bench.py")).read()
+    assert "or checksum_deviation:" in src
+    assert '"checksum_deviation"' in src

@@ -207,6 +207,62 @@ def test_persisted_suspects_reseed_from_reduced_record():
     assert h.persisted_suspects({}) == set()
 
 
+def test_certified_env_cfgless_claim_returns_sentinel(tmp_path,
+                                                      monkeypatch):
+    """ADVICE r5 medium: a pre-migration state file claims
+    verify_beststream done but its record carries no cfg. The watcher
+    greps the RAW file, sees a certification, and asks certified_env
+    for the phase-2 env — which must return the shipped-default
+    sentinel (empty string), mirroring load_state()'s cfgless-record
+    re-verify guard, so the watcher never ships the static (matrix
+    -sort-bearing) BESTSTREAM flips uncertified."""
+    h = _harvest()
+    p = tmp_path / "state.json"
+    p.write_text(json.dumps({
+        "version": h.STATE_VERSION,
+        "done": ["verify_beststream"],
+        "results": {},
+    }))
+    monkeypatch.setattr(h, "STATE_PATH", str(p))
+    assert h.certified_env() == ""
+    # a cfgless RESULTS record (done or not) is the same claim
+    p.write_text(json.dumps({
+        "version": h.STATE_VERSION,
+        "done": [],
+        "results": {"verify_beststream": {"verdict": "MATCH"}},
+    }))
+    assert h.certified_env() == ""
+    # a version-mismatched file whose raw text still claims the
+    # certification: load_state discards everything, and the watcher's
+    # grep still matches — sentinel again, never the static flips
+    p.write_text(json.dumps({
+        "version": h.STATE_VERSION - 1,
+        "done": ["verify_beststream"],
+        "results": {"verify_beststream": {
+            "cfg": {"CAUSE_TPU_GATHER": "rowgather"}}},
+    }))
+    assert h.certified_env() == ""
+
+
+def test_decide_cfgless_bench_record_falls_back_to_vcfg(tmp_path):
+    """ADVICE r5 low: when the bench record lacks cfg, the flip must
+    ship the CERTIFIED vcfg — not flips_of(BESTSTREAM), which can
+    differ from a reduced certification (exactly the drift the
+    coherence check exists to prevent)."""
+    h = _harvest()
+    path = str(tmp_path / "d.json")
+    results = _results(bench_xla_base=3750.0, bench_beststream=3000.0)
+    reduced = {"CAUSE_TPU_GATHER": "rowgather"}
+    results["verify_beststream"] = {
+        "verdict": "MATCH-REDUCED", "cfg": dict(reduced)}
+    # note: NO cfg on the bench record
+    assert "cfg" not in results["bench_beststream"]
+    h.decide_defaults(done={"verify_beststream"}, results=results,
+                      plat="tpu", path=path)
+    rec = json.loads(open(path).read())
+    assert rec["switches"] == reduced  # vcfg, not the static constant
+
+
 def test_decide_requires_digest_certification(tmp_path):
     h = _harvest()
     path = str(tmp_path / "d.json")
