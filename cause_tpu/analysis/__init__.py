@@ -1,0 +1,31 @@
+"""causelint — trace-identity and jit-purity static analysis.
+
+The framework's correctness leans on conventions nothing used to
+enforce mechanically: trace-time switches are *imported, never
+restated* and appear in every program-cache key; obs-off code paths
+read zero TRACE_SWITCHES env vars; jit-reachable code is free of host
+effects; lane-cache arena views are never mutated in place outside
+their owner. Each convention is the fossil of a real fixed bug (stale
+sharded programs across switch flips, uncertified static flips,
+blocking tunnel claims from cache lookups) — this package turns them
+into CI-gated rules. See ``rules`` for the TID/JPH/OBS/LCA catalog,
+``callgraph`` for the jit-reachability machinery, and ``__main__``
+for the CLI (``python -m cause_tpu.analysis``).
+
+Deliberately dependency-light: stdlib ``ast`` plus
+``cause_tpu.switches`` (itself import-free) — no jax, no numpy, so
+the lint gate runs before the test matrix installs anything.
+"""
+
+from .core import AnalysisResult, Finding, list_rules, run
+from .report import load_baseline, to_json, write_baseline
+
+__all__ = [
+    "AnalysisResult",
+    "Finding",
+    "list_rules",
+    "load_baseline",
+    "run",
+    "to_json",
+    "write_baseline",
+]

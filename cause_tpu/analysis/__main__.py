@@ -1,0 +1,96 @@
+"""``python -m cause_tpu.analysis [paths...]`` — the causelint CLI.
+
+Exit codes: 0 = clean (after suppressions and baseline), 1 = findings,
+2 = usage error. Stdlib-only: the CI lint job runs this from a bare
+checkout, before jax/numpy are installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import core, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cause_tpu.analysis",
+        description=("causelint: trace-identity (TID), jit-purity "
+                     "(JPH), obs-off invariance (OBS) and lane-cache "
+                     "aliasing (LCA) static analysis"),
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/directories to analyze (default: "
+                         "cause_tpu/ scripts/ bench.py where present)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--rules",
+                    help="comma-separated rule ids to run "
+                         "(default: all)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="freeze findings recorded in FILE (see "
+                         "--write-baseline); only NEW findings gate")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="record current findings into FILE and exit 0")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, help_text in core.list_rules():
+            print(f"{rid}  {help_text}")
+        return 0
+
+    paths = args.paths
+    if not paths:
+        paths = [p for p in ("cause_tpu", "scripts", "bench.py")
+                 if os.path.exists(p)]
+        if not paths:
+            print("causelint: no paths given and no default layout "
+                  "found", file=sys.stderr)
+            return 2
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"causelint: no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+
+    rule_ids = None
+    if args.rules:
+        rule_ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in rule_ids
+                   if r not in dict(core.list_rules())]
+        if unknown:
+            print(f"causelint: unknown rule(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+        # GEN rules are the driver's own and cannot be toggled; an
+        # explicitly emptied selection still reports parse errors
+        rule_ids = [r for r in rule_ids if not r.startswith("GEN")]
+
+    result = core.run(paths, rule_ids=rule_ids)
+
+    if args.write_baseline:
+        n = report.write_baseline(args.write_baseline, result)
+        print(f"causelint: froze {n} finding(s) into "
+              f"{args.write_baseline}")
+        return 0
+
+    baseline_filtered = 0
+    if args.baseline:
+        baseline_filtered = report.apply_baseline(
+            result, report.load_baseline(args.baseline))
+
+    if args.format == "json":
+        import json
+
+        print(json.dumps(report.to_json(result, baseline_filtered),
+                         indent=2, sort_keys=True))
+    else:
+        print(report.render_text(result, baseline_filtered))
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,578 @@
+"""The causelint rule families, each grounded in a shipped incident.
+
+- **TID** — trace-identity soundness. The CAUSE_TPU_* strategy
+  switches are read at trace time, so they are program identity:
+  every name must be registered (TRACE_SWITCHES or KNOWN_ENV_KNOBS),
+  never restated as a literal outside switches.py, and every host-side
+  cache of a traced program must fold the switch snapshot into its key
+  (the round-4/5 stale-program incidents).
+- **JPH** — jit-purity hazards. Host effects inside jit-reachable
+  code run at trace time only (or break retracing): env reads, clock
+  reads, print, open, ``.item()``, mutation of module-level state.
+- **OBS** — obs-off invariance. ``cause_tpu/obs`` must read zero
+  TRACE_SWITCHES env vars on any path the disabled mode reaches, and
+  traced code may only touch the guarded no-op instrument factories.
+- **LCA** — lane-cache aliasing. LaneArena columns are shared by
+  every view of a tree; in-place stores outside the arena-owning
+  ``lanecache`` module corrupt sibling views silently.
+
+Every rule is a function ``(ctx, module) -> yields Finding`` registered
+in :data:`REGISTRY`. Rules receive the cross-module
+:class:`~cause_tpu.analysis.callgraph.Program` via ``ctx`` so the
+jit-reachability answer is shared, not recomputed.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Iterator, List, Optional
+
+from .callgraph import FuncInfo, ModuleInfo, Program, dotted_parts
+
+# imported from the one authority — the module rule this linter
+# enforces applies to the linter too
+from ..switches import KNOWN_ENV_KNOBS, TRACE_SWITCHES
+
+SWITCH_HELPERS = frozenset({"resolve", "raw_key", "raw_switch_key"})
+_ENV_READ_ATTRS = frozenset({"get", "pop", "setdefault", "__getitem__"})
+_CACHE_DECOS = frozenset({"lru_cache", "cache"})
+_OBS_GUARDED = frozenset({"span", "counter", "gauge", "event"})
+_OBS_UNGUARDED = frozenset(
+    {"flush", "configure", "reset", "counters_snapshot", "events",
+     "export_jsonl", "set_platform", "load_jsonl"}
+)
+ARENA_COLS = frozenset(
+    {"ts", "site", "tx", "cause_idx", "vclass", "cause_hi", "cause_lo"}
+)
+# the arena-owning module: its committed-mutation sites (extend_view's
+# in-place append, sync_ranks' rank upgrade) are the whitelist the LCA
+# family is defined around
+_ARENA_OWNER = "lanecache"
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    @property
+    def family(self) -> str:
+        return self.rule.rstrip("0123456789")
+
+
+@dataclass
+class RuleSpec:
+    rule_id: str
+    help: str
+    check: object  # callable(ctx, module) -> Iterator[Finding]
+
+
+REGISTRY: dict = {}
+
+
+def rule(rule_id: str, help_text: str):
+    def deco(fn):
+        REGISTRY[rule_id] = RuleSpec(rule_id, help_text, fn)
+        return fn
+    return deco
+
+
+class Context:
+    """Shared per-run state handed to every rule."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self.reachable = program.reachable()
+
+    def reachable_funcs(self, module: ModuleInfo) -> List[FuncInfo]:
+        return [f for fid, f in module.funcs.items()
+                if fid in self.reachable]
+
+
+# --------------------------------------------------------------- utils
+
+def _finding(rule_id: str, module: ModuleInfo, node: ast.AST,
+             message: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    snippet = (module.lines[line - 1].strip()
+               if 0 < line <= len(module.lines) else "")
+    return Finding(rule_id, module.path, line,
+                   getattr(node, "col_offset", 0), message, snippet)
+
+
+def _env_read_key(node: ast.Call) -> Optional[ast.expr]:
+    """The key expression of an ``os.environ.get/pop/...`` or
+    ``os.getenv`` call, else None."""
+    parts = dotted_parts(node.func)
+    if parts is None:
+        return None
+    if parts[-1] == "getenv" or (
+            len(parts) >= 2 and parts[-2] == "environ"
+            and parts[-1] in _ENV_READ_ATTRS):
+        return node.args[0] if node.args else None
+    return None
+
+
+def _environ_subscript(node: ast.AST) -> Optional[ast.expr]:
+    """``os.environ[KEY]`` (read or write target) -> KEY, else None."""
+    if isinstance(node, ast.Subscript):
+        parts = dotted_parts(node.value)
+        if parts is not None and parts[-1] == "environ":
+            return node.slice
+    return None
+
+
+def _iter_env_keys(tree_nodes) -> Iterator[ast.expr]:
+    """Every env-var key expression (call-style and subscript-style)
+    in an AST node stream."""
+    for n in tree_nodes:
+        if isinstance(n, ast.Call):
+            key = _env_read_key(n)
+            if key is not None:
+                yield key
+        key = _environ_subscript(n)
+        if key is not None:
+            yield key
+
+
+def _literal(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _is_switches_module(module: ModuleInfo) -> bool:
+    return module.segments[-1] == "switches"
+
+
+def _in_obs_package(module: ModuleInfo) -> bool:
+    return "obs" in module.segments[:-1] or module.segments[-1] == "obs"
+
+
+def _docstring_lines(module: ModuleInfo) -> set:
+    """Line spans of docstring constants (skipped by literal rules)."""
+    out = set()
+    if module.tree is None:
+        return out
+    for n in ast.walk(module.tree):
+        if isinstance(n, (ast.Module, ast.FunctionDef,
+                          ast.AsyncFunctionDef, ast.ClassDef)):
+            body = getattr(n, "body", [])
+            if body and isinstance(body[0], ast.Expr) and isinstance(
+                    body[0].value, ast.Constant) and isinstance(
+                    body[0].value.value, str):
+                c = body[0].value
+                out.update(range(c.lineno, (c.end_lineno or c.lineno) + 1))
+    return out
+
+
+# ----------------------------------------------------------------- TID
+
+@rule("TID001",
+      "trace-reachable read of a CAUSE_TPU_* env var that is not a "
+      "registered TRACE_SWITCHES member")
+def check_tid001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _is_switches_module(module):
+        return
+    registered = set(TRACE_SWITCHES) | set(KNOWN_ENV_KNOBS)
+    for info in ctx.reachable_funcs(module):
+        for key in _iter_env_keys(info.body_nodes()):
+            name = _literal(key)
+            if (name and name.startswith("CAUSE_TPU_")
+                    and name not in registered):
+                yield _finding(
+                    "TID001", module, key,
+                    f"jit-reachable code reads {name!r}, which is in "
+                    "neither TRACE_SWITCHES nor KNOWN_ENV_KNOBS — an "
+                    "unregistered trace-time config axis never reaches "
+                    "program-cache keys (import the registry in "
+                    "cause_tpu/switches.py, never invent names)")
+    # helper misuse is a hazard anywhere: resolve()/raw_key() on an
+    # unknown name silently returns "" forever
+    if module.tree is None:
+        return
+    for n in ast.walk(module.tree):
+        if isinstance(n, ast.Call):
+            parts = dotted_parts(n.func)
+            if (parts is not None and parts[-1] in ("resolve", "raw_key")
+                    and n.args):
+                name = _literal(n.args[0])
+                if (name and name.startswith("CAUSE_TPU_")
+                        and name not in TRACE_SWITCHES):
+                    yield _finding(
+                        "TID001", module, n,
+                        f"switch helper called with {name!r}, which is "
+                        "not a TRACE_SWITCHES member — the read can "
+                        "never be part of program identity")
+
+
+@rule("TID002",
+      "TRACE_SWITCHES name restated as a string literal outside "
+      "switches.py (module rule: import, never restate)")
+def check_tid002(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _is_switches_module(module) or module.tree is None:
+        return
+    doc_lines = _docstring_lines(module)
+    # literals passed straight to the switch helpers are the sanctioned
+    # read pattern, not a restatement
+    helper_args = set()
+    for n in ast.walk(module.tree):
+        if isinstance(n, ast.Call):
+            parts = dotted_parts(n.func)
+            if parts is not None and parts[-1] in SWITCH_HELPERS:
+                for a in n.args:
+                    helper_args.add(id(a))
+    for n in ast.walk(module.tree):
+        if not isinstance(n, ast.Constant) or not isinstance(n.value, str):
+            continue
+        if n.lineno in doc_lines or id(n) in helper_args:
+            continue
+        head = n.value.split("=", 1)[0]
+        if head in TRACE_SWITCHES:
+            yield _finding(
+                "TID002", module, n,
+                f"switch name {head!r} restated as a literal — a copy "
+                "that drifts from switches.py silently serves/keys a "
+                "different program config; import TRACE_SWITCHES / "
+                "BESTSTREAM_FLIPS instead (or suppress with a reason "
+                "for deliberate A/B flips)")
+
+
+@rule("TID003",
+      "host-side cache of a traced program whose key omits the switch "
+      "snapshot (stale-program hazard)")
+def check_tid003(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    for fid, info in module.funcs.items():
+        node = info.node
+        if isinstance(node, ast.Lambda):
+            continue
+        if not any(
+            (dotted_parts(d) or ["?"])[-1] in _CACHE_DECOS
+            or (isinstance(d, ast.Call)
+                and (dotted_parts(d.func) or ["?"])[-1] in _CACHE_DECOS)
+            for d in node.decorator_list
+        ):
+            continue
+        # trace roots lexically inside this cached factory
+        inner = [f for f in ctx.program.roots
+                 if f.startswith(fid + ".")] + (
+            [fid] if fid in ctx.program.roots else [])
+        if not inner:
+            continue
+        traced = ctx.program.reachable_from(inner)
+        reads_switches = False
+        for tfid in traced:
+            tinfo = ctx.program.funcs[tfid]
+            for parts, _ln in tinfo.calls:
+                if parts[-1] in SWITCH_HELPERS:
+                    reads_switches = True
+            for key in _iter_env_keys(tinfo.body_nodes()):
+                name = _literal(key)
+                if name in TRACE_SWITCHES:
+                    reads_switches = True
+        if not reads_switches:
+            continue
+        params = {a.arg for a in (
+            list(node.args.posonlyargs) + list(node.args.args)
+            + list(node.args.kwonlyargs))}
+        if "switches" not in params:
+            yield _finding(
+                "TID003", module, node,
+                f"{info.qualname} caches a traced program that reads "
+                "TRACE_SWITCHES at trace time, but its cache key has "
+                "no `switches` parameter — after a switch flip the "
+                "cache serves the program traced under the OLD config "
+                "(fold switches.raw_switch_key() into the key)")
+
+
+# ----------------------------------------------------------------- JPH
+
+_JPH_EXEMPT_LAST_SEG = frozenset({"switches"})
+
+
+def _jph_applies(module: ModuleInfo) -> bool:
+    # switches.py's resolve/raw_key ARE the sanctioned trace-time env
+    # readers; the obs package's guard discipline is the OBS family's
+    # job (its factories run host-side at trace time by design)
+    return (module.segments[-1] not in _JPH_EXEMPT_LAST_SEG
+            and not _in_obs_package(module))
+
+
+@rule("JPH001",
+      "direct os.environ access inside jit-reachable code (route "
+      "trace-time config through switches.resolve/raw_key)")
+def check_jph001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if not _jph_applies(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for key in _iter_env_keys(info.body_nodes()):
+            name = _literal(key)
+            yield _finding(
+                "JPH001", module, key,
+                "jit-reachable code reads the environment directly"
+                + (f" ({name!r})" if name else "")
+                + " — the value binds at trace time and never joins "
+                "program identity; use switches.resolve()/raw_key() "
+                "(registered switches) or hoist the read to host code")
+
+
+@rule("JPH002",
+      "clock read (time.*) inside jit-reachable code")
+def check_jph002(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if not _jph_applies(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for n in info.body_nodes():
+            if isinstance(n, ast.Call):
+                parts = dotted_parts(n.func)
+                if (parts is not None and len(parts) >= 2
+                        and parts[-2] == "time"):
+                    yield _finding(
+                        "JPH002", module, n,
+                        f"time.{parts[-1]}() inside jit-reachable code "
+                        "runs once at trace time, not per step — hoist "
+                        "to the host caller (obs spans time host-side)")
+
+
+@rule("JPH003", "print() inside jit-reachable code")
+def check_jph003(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if not _jph_applies(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for n in info.body_nodes():
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "print"):
+                yield _finding(
+                    "JPH003", module, n,
+                    "print() inside jit-reachable code fires at trace "
+                    "time only (silent after the first call) — use "
+                    "jax.debug.print or host-side obs events")
+
+
+@rule("JPH004", "open() inside jit-reachable code")
+def check_jph004(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if not _jph_applies(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for n in info.body_nodes():
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "open"):
+                yield _finding(
+                    "JPH004", module, n,
+                    "open() inside jit-reachable code is a host file "
+                    "effect at trace time — hoist it to the caller")
+
+
+@rule("JPH005",
+      ".item()/float()-on-parameter inside jit-reachable code "
+      "(forces a device sync / fails under trace)")
+def check_jph005(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if not _jph_applies(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        params = set()
+        if not isinstance(info.node, ast.Lambda):
+            args = info.node.args
+            params = {a.arg for a in (
+                list(args.posonlyargs) + list(args.args)
+                + list(args.kwonlyargs))}
+        for n in info.body_nodes():
+            if not isinstance(n, ast.Call):
+                continue
+            if (isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "item" and not n.args):
+                yield _finding(
+                    "JPH005", module, n,
+                    ".item() on a traced value aborts tracing (or "
+                    "blocks on device sync) — keep reductions in the "
+                    "program and fetch on the host")
+            elif (isinstance(n.func, ast.Name) and n.func.id == "float"
+                    and n.args and isinstance(n.args[0], ast.Name)
+                    and n.args[0].id in params):
+                yield _finding(
+                    "JPH005", module, n,
+                    "float() on a traced argument aborts tracing — "
+                    "use .astype()/jnp casts inside the program")
+
+
+@rule("JPH006",
+      "mutation of module-level state inside jit-reachable code "
+      "(trace-time side effect; silently stale on cache hits)")
+def check_jph006(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if not _jph_applies(module):
+        return
+    module_level = set(module.top_funcs)
+    if module.tree is not None:
+        for n in module.tree.body:
+            if isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        module_level.add(t.id)
+            elif isinstance(n, (ast.AnnAssign, ast.AugAssign)):
+                if isinstance(n.target, ast.Name):
+                    module_level.add(n.target.id)
+    mutators = {"append", "add", "update", "setdefault", "pop",
+                "clear", "extend", "insert", "popitem"}
+    for info in ctx.reachable_funcs(module):
+        declared_global = set()
+        for n in info.body_nodes():
+            if isinstance(n, ast.Global):
+                declared_global.update(n.names)
+        for n in info.body_nodes():
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                targets = [n.target]
+            for t in targets:
+                base = t
+                while isinstance(base, (ast.Subscript, ast.Attribute)):
+                    base = base.value
+                if (isinstance(base, ast.Name) and base is not t
+                        and base.id in module_level):
+                    yield _finding(
+                        "JPH006", module, t,
+                        f"jit-reachable code mutates module-level "
+                        f"{base.id!r} — runs at trace time only, so "
+                        "cached executions silently skip it")
+                elif isinstance(t, ast.Name) and t.id in declared_global:
+                    yield _finding(
+                        "JPH006", module, t,
+                        f"jit-reachable code rebinds global {t.id!r} "
+                        "at trace time only")
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in mutators
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id in module_level):
+                yield _finding(
+                    "JPH006", module, n,
+                    f"jit-reachable code calls .{n.func.attr}() on "
+                    f"module-level {n.func.value.id!r} — a trace-time "
+                    "side effect cached executions skip")
+
+
+# ----------------------------------------------------------------- OBS
+
+@rule("OBS001",
+      "cause_tpu/obs reads a TRACE_SWITCHES env var (obs-off "
+      "invariance: disabled mode must add zero identity-adjacent "
+      "env reads)")
+def check_obs001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if not _in_obs_package(module) or module.tree is None:
+        return
+    for key in _iter_env_keys(ast.walk(module.tree)):
+        name = _literal(key)
+        if name is None:
+            yield _finding(
+                "OBS001", module, key,
+                "obs reads an env var through a non-literal key — "
+                "causelint cannot prove it is not a TRACE_SWITCHES "
+                "member; read via a literal, or suppress with a "
+                "reason at the one sanctioned enabled-span snapshot")
+        elif name in TRACE_SWITCHES:
+            yield _finding(
+                "OBS001", module, key,
+                f"obs reads trace switch {name!r} — the obs-off "
+                "contract is ZERO TRACE_SWITCHES reads (program "
+                "identity must not depend on whether obs is on)")
+
+
+@rule("OBS002",
+      "jit-reachable code calls an unguarded obs API (only the no-op "
+      "factories span/counter/gauge/event may sit on traced paths)")
+def check_obs002(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for parts, lineno in info.calls:
+            if parts[-1] not in _OBS_UNGUARDED:
+                continue
+            target = ctx.program.resolve_call(info, parts)
+            if target is not None:
+                tmod = target.split("::", 1)[0]
+                is_obs = "obs" in tmod.split(".")
+            else:
+                # unresolved: trust the spelling — obs.flush(),
+                # _obs_flush(), aliased obs module attributes
+                is_obs = (len(parts) >= 2 and "obs" in parts[:-1]) or \
+                    parts[0].startswith("_obs")
+            if not is_obs:
+                continue
+            node = ast.Constant(value="")
+            node.lineno, node.col_offset = lineno, 0
+            yield _finding(
+                "OBS002", module, node,
+                f"obs.{parts[-1]}() inside jit-reachable code does "
+                "unconditional work even with obs disabled — hot "
+                "paths route through span()/counter()/gauge()/"
+                "event(), which collapse to shared no-ops")
+
+
+# ----------------------------------------------------------------- LCA
+
+@rule("LCA001",
+      "in-place store into a LaneArena column outside the arena-owning "
+      "lanecache module (aliased views share those arrays)")
+def check_lca001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if module.segments[-1] == _ARENA_OWNER or module.tree is None:
+        return
+    for info in module.funcs.values():
+        # names bound from <expr>.arena in this scope (plus parameters
+        # conventionally named `arena`)
+        aliases = set()
+        if not isinstance(info.node, ast.Lambda):
+            aliases = {a.arg for a in info.node.args.args
+                       if a.arg == "arena"}
+        for n in info.body_nodes():
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], (ast.Name, ast.Tuple)):
+                tgts = (n.targets[0].elts
+                        if isinstance(n.targets[0], ast.Tuple)
+                        else [n.targets[0]])
+                vals = (n.value.elts
+                        if isinstance(n.value, ast.Tuple)
+                        and isinstance(n.targets[0], ast.Tuple)
+                        and len(n.value.elts) == len(tgts)
+                        else [n.value] * len(tgts))
+                for t, v in zip(tgts, vals):
+                    if (isinstance(t, ast.Name)
+                            and isinstance(v, ast.Attribute)
+                            and v.attr == "arena"):
+                        aliases.add(t.id)
+        for n in info.body_nodes():
+            targets = []
+            if isinstance(n, ast.Assign):
+                targets = n.targets
+            elif isinstance(n, ast.AugAssign):
+                targets = [n.target]
+            for t in targets:
+                if not (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and t.value.attr in ARENA_COLS):
+                    continue
+                base = t.value.value
+                is_arena = (
+                    (isinstance(base, ast.Name) and base.id in aliases)
+                    or (isinstance(base, ast.Attribute)
+                        and base.attr == "arena")
+                )
+                if is_arena:
+                    yield _finding(
+                        "LCA001", module, t,
+                        f"in-place store into arena column "
+                        f"'{t.value.attr}' outside weaver/lanecache — "
+                        "every LaneView over this arena aliases that "
+                        "array, so sibling tree versions see the "
+                        "mutation; copy via _copy_arena/build_view or "
+                        "add the site to lanecache's committed-append "
+                        "path")
+
+
+def all_rule_ids() -> List[str]:
+    return sorted(REGISTRY)

@@ -207,10 +207,10 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
     # mapping lives in switches.py next to resolve() so key and
     # trace-time resolution cannot drift.
     from .obs import counter as _obs_counter, span as _obs_span
-    from .switches import TRACE_SWITCHES, raw_key
+    from .switches import raw_switch_key
 
-    switches = tuple(raw_key(k) for k in TRACE_SWITCHES)
-    key = (k_max, kernel if k_max > 0 else "v1", u_max, switches)
+    key = (k_max, kernel if k_max > 0 else "v1", u_max,
+           raw_switch_key())
     program = _scalar_programs.get(key)
     if program is None:
         # program-cache provenance: every miss is a fresh trace (and on
@@ -278,6 +278,18 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
                 row = (jnp.sum(x, axis=1)
                        ^ (conflict.astype(jnp.uint32)
                           * jnp.uint32(0x27D4EB2F)))
+                # fold the ROW INDEX into the mix before the modular
+                # cross-row sum (ADVICE r5 #4): the plain sum was
+                # permutation-invariant across rows, so compensating
+                # per-row errors (row i off by +d, row j by -d, or two
+                # rows swapped) cancelled. Rotating each row digest by
+                # row & 31 breaks that symmetry while keeping the
+                # checksum exact and config-independent; (32-r)&31
+                # keeps the r==0 shift in-range.
+                rix = jax.lax.broadcasted_iota(
+                    jnp.uint32, row.shape, 0) & jnp.uint32(31)
+                row = (row << rix) | (
+                    row >> ((jnp.uint32(32) - rix) & jnp.uint32(31)))
                 digest = jax.lax.bitcast_convert_type(
                     jnp.sum(row), jnp.int32)
                 return jnp.stack([

@@ -82,7 +82,6 @@ from .collections import shared as s
 from .collections.clist import hide_q, weave as list_weave
 from .collections.cmap import BLANK, active_node, weave as map_weave
 from .ids import ROOT_ID, is_id
-from .weaver.arrays import vclass_of
 
 __all__ = ["compact", "compact_stats", "stability_frontier"]
 
@@ -109,6 +108,11 @@ def stability_frontier(*version_vectors: dict) -> Dict[str, list]:
 def _closure(nodes: dict, keep: Set[tuple]) -> Set[tuple]:
     """Cause ancestors of everything kept, plus specials targeting
     kept nodes, to a fixpoint."""
+    # function-level: arrays drags numpy in, and `import cause_tpu`
+    # (hence the jax-free, numpy-free causelint CLI and bench.py's
+    # parent process) must stay stdlib-importable
+    from .weaver.arrays import vclass_of
+
     keep = set(keep)
     # specials grouped by (id-)target once, so the fixpoint loop is
     # O(kept + specials) instead of O(kept * nodes)

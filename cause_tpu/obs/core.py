@@ -261,7 +261,11 @@ def _switches_snapshot() -> Dict[str, str]:
     trace-time identity."""
     out = {}
     for k in TRACE_SWITCHES:
-        v = os.environ.get(k, "")
+        # the ONE sanctioned TRACE_SWITCHES read in obs: it runs only
+        # on enabled-span close (disabled mode returns _NULL_SPAN and
+        # never reaches this function), so the obs-off zero-reads
+        # contract holds
+        v = os.environ.get(k, "")  # causelint: disable=OBS001 -- enabled-span close only; obs-off never reaches here
         if v:
             out[k] = v
     return out

@@ -29,6 +29,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..switches import raw_switch_key
 from ..weaver.jaxw import merge_weave_kernel, merge_weave_kernel_v2
 
 try:  # JAX >= 0.4.35 exports shard_map at the top level
@@ -118,13 +119,23 @@ def _fleet_stats(axis, hi, lo, order, rank, visible, conflict, overflow):
 
 
 @lru_cache(maxsize=8)
-def _sharded_step(mesh: Mesh, k_max: int, kernel: str = "v3"):
+def _sharded_step(mesh: Mesh, k_max: int, kernel: str,
+                  switches: tuple):
     """The jitted sharded merge step for one mesh (cached so repeat
     merge waves hit the jit cache instead of re-tracing). ``k_max`` > 0
     runs a compressed kernel — ``kernel`` picks the sparse-irregular
     "v3" (default) or chain-compressed "v2" — with that run budget
     (overflowed rows are psum-counted fleet-wide); 0 runs the
-    uncompressed kernel."""
+    uncompressed kernel.
+
+    ``switches`` is the ``raw_switch_key()`` snapshot and exists ONLY
+    to key the cache: the kernels read the CAUSE_TPU_* strategy
+    switches via ``resolve()`` at trace time, so a cache keyed on
+    (mesh, k_max, kernel) alone kept serving the step traced under the
+    PREVIOUS switch config after a flip — the same stale-program class
+    benchgen.merge_wave_scalar's key fixed in round 4. A distinct
+    snapshot mints a fresh ``jax.jit`` wrapper, whose own aval cache
+    then re-traces under the new config."""
     axis = mesh.axis_names[0]
     sharded = P(axis)
     replicated = P()
@@ -171,14 +182,17 @@ def sharded_merge_weave(mesh: Mesh, hi, lo, cause_hi, cause_lo, vclass, valid,
     """
     # normalize the cache key: kernel is only consulted when k_max > 0,
     # so k_max=0 calls must not mint per-kernel duplicate programs
-    step = _sharded_step(mesh, k_max, kernel if k_max > 0 else "v1")
+    step = _sharded_step(mesh, k_max, kernel if k_max > 0 else "v1",
+                         raw_switch_key())
     return step(hi, lo, cause_hi, cause_lo, vclass, valid)
 
 
 @lru_cache(maxsize=8)
-def _sharded_step_v4(mesh: Mesh, k_max: int):
+def _sharded_step_v4(mesh: Mesh, k_max: int, switches: tuple):
     """The v4 twin of ``_sharded_step``: 5 lanes (cause ids replaced by
-    the marshal-time concat cause-index lane), same outputs."""
+    the marshal-time concat cause-index lane), same outputs.
+    ``switches`` keys the cache on the trace-time strategy snapshot
+    (see ``_sharded_step``)."""
     from ..weaver.jaxw4 import merge_weave_kernel_v4
 
     axis = mesh.axis_names[0]
@@ -208,14 +222,17 @@ def sharded_merge_weave_v4(mesh: Mesh, hi, lo, cci, vclass, valid,
     (the cause's index in the concatenated pre-sort array, resolved at
     marshal time) instead of cause id lanes. Same outputs; the batch
     dimension must be divisible by the mesh size."""
-    return _sharded_step_v4(mesh, k_max)(hi, lo, cci, vclass, valid)
+    return _sharded_step_v4(mesh, k_max, raw_switch_key())(
+        hi, lo, cci, vclass, valid)
 
 
 @lru_cache(maxsize=8)
 def _sharded_step_v5(mesh: Mesh, u_max: int, k_max: int,
-                     pipeline: str = "v5"):
+                     pipeline: str, switches: tuple):
     """The v5 (segment-union) sharded step: node lanes + segment
-    tables in, per-replica (rank, visible, digest) + fleet stats out.
+    tables in, per-replica (rank, visible, digest) + fleet stats out;
+    ``switches`` keys the cache on the trace-time strategy snapshot
+    (see ``_sharded_step``).
     v5 reports in concat-lane coordinates and produces no ``order``;
     the digest's mix-sum is permutation-invariant, so feeding the raw
     lanes with concat-coordinate ranks yields the same digest value as
@@ -280,5 +297,6 @@ def sharded_merge_weave_v5(mesh: Mesh, lanes: dict, u_max: int,
     (shared.union_nodes does)."""
     from ..benchgen import LANE_KEYS5
 
-    step = _sharded_step_v5(mesh, u_max, k_max, pipeline)
+    step = _sharded_step_v5(mesh, u_max, k_max, pipeline,
+                            raw_switch_key())
     return step(*(lanes[k] for k in LANE_KEYS5))

@@ -25,6 +25,22 @@ TRACE_SWITCHES = (
     "CAUSE_TPU_FPHASE",
 )
 
+# CAUSE_TPU_-namespace env vars that are deliberately NOT program
+# identity: observability, host-side sampling, and file-location knobs
+# whose values never reach a traced program. Every CAUSE_TPU_* read in
+# the tree must name a member of exactly one of these two registries —
+# causelint (cause_tpu.analysis, rule family TID) fails CI on reads of
+# unregistered names, so a typo'd switch can't silently become a
+# cache-key-less config axis.
+KNOWN_ENV_KNOBS = (
+    "CAUSE_TPU_OBS",
+    "CAUSE_TPU_OBS_OUT",
+    "CAUSE_TPU_OBS_RING",
+    "CAUSE_TPU_DEFAULTS_FILE",
+    "CAUSE_TPU_NATIVE_CACHE",
+    "CAUSE_TPU_BODY_SAMPLE",
+)
+
 # The XLA-only streaming candidate combination ("beststream"): the
 # switch set the harvest ladder digest-gates and certifies, and the
 # one bench.py self-selects against when no certified defaults exist
@@ -115,6 +131,17 @@ def raw_key(name: str) -> str:
     if v == "xla" and name not in TPU_DEFAULTS:
         return ""
     return v
+
+
+def raw_switch_key() -> tuple:
+    """The full program-identity snapshot as a cache-key tuple: one
+    ``raw_key`` value per TRACE_SWITCHES member, in registry order.
+    EVERY host-side cache of a traced program (benchgen's scalar
+    programs, parallel.mesh's sharded steps) must fold this tuple into
+    its key, or a switch flip serves a stale program — the round-4/5
+    incident class causelint rule TID003 now gates. Backend-init-free
+    like raw_key itself."""
+    return tuple(raw_key(k) for k in TRACE_SWITCHES)
 
 
 def resolve(name: str) -> str:

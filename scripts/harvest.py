@@ -134,8 +134,10 @@ MOSAICSTREAM = cfg_of(CAUSE_TPU_SORT="pallas",
                       CAUSE_TPU_SEARCH="matrix-table",
                       CAUSE_TPU_SCATTER="hint",
                       CAUSE_TPU_FPHASE="pallas")
-# strategy pairs that require a Mosaic kernel compile
-MOSAIC_VALUES = {"CAUSE_TPU_SORT=pallas", "CAUSE_TPU_FPHASE=pallas",
+# strategy pairs that require a Mosaic kernel compile — a DENYLIST of
+# specific values (flip strings), not a restated config: the ladder
+# still builds every config from BESTSTREAM_FLIPS/cfg_of
+MOSAIC_VALUES = {"CAUSE_TPU_SORT=pallas", "CAUSE_TPU_FPHASE=pallas",  # causelint: disable=TID002 -- denylist of Mosaic values, not a config copy
                  "euler=walk", "kernel=v5f"}
 TRY_MOSAIC = os.environ.get("HARVEST_TRY_MOSAIC", "").strip() == "1"
 

@@ -45,9 +45,12 @@ def main():
     if a.allstream:
         import os
 
-        os.environ["CAUSE_TPU_SORT"] = "bitonic"
-        os.environ["CAUSE_TPU_GATHER"] = "rowgather"
-        os.environ["CAUSE_TPU_SEARCH"] = "matrix"
+        # deliberate A/B flip of this probe's own child config (NOT
+        # the beststream candidate — the stage probe wants the bitonic
+        # sort specifically), so the restated names are intentional
+        os.environ["CAUSE_TPU_SORT"] = "bitonic"  # causelint: disable=TID002 -- probe flips its own A/B config
+        os.environ["CAUSE_TPU_GATHER"] = "rowgather"  # causelint: disable=TID002 -- probe flips its own A/B config
+        os.environ["CAUSE_TPU_SEARCH"] = "matrix"  # causelint: disable=TID002 -- probe flips its own A/B config
     if a.smoke:
         B, NB, ND, CAP = 8, 800, 100, 1024
     else:

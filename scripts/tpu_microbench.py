@@ -257,10 +257,12 @@ def _search_bench(mode):
     rng = np.random.default_rng(4)
     kc = jnp.asarray(np.cumsum(
         rng.integers(0, 3, (1024, 2252)), axis=1).astype(np.int32))
+    # the microbench A/Bs the search strategies against each other, so
+    # flipping the one switch by name is the point of this function
     if mode:
-        os.environ["CAUSE_TPU_SEARCH"] = mode
+        os.environ["CAUSE_TPU_SEARCH"] = mode  # causelint: disable=TID002 -- microbench A/Bs this switch deliberately
     else:
-        os.environ.pop("CAUSE_TPU_SEARCH", None)
+        os.environ.pop("CAUSE_TPU_SEARCH", None)  # causelint: disable=TID002 -- microbench A/Bs this switch deliberately
     try:
         def f(k):
             out = jax.vmap(
@@ -270,7 +272,7 @@ def _search_bench(mode):
 
         return _slope(f, (kc,))
     finally:
-        os.environ.pop("CAUSE_TPU_SEARCH", None)
+        os.environ.pop("CAUSE_TPU_SEARCH", None)  # causelint: disable=TID002 -- microbench A/Bs this switch deliberately
 
 
 def bench_searchhist():

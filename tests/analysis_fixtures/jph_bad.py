@@ -1,0 +1,24 @@
+"""Known-bad JPH fixture: every jit-purity rule must fire here."""
+
+import os
+import time
+
+import jax
+
+_CACHE = {}
+
+
+@jax.jit
+def impure(x):
+    os.environ["SOME_VAR"] = "1"          # JPH001
+    t = time.perf_counter()               # JPH002
+    print("tracing", t)                   # JPH003
+    with open("/tmp/jph.log", "w") as f:  # JPH004
+        f.write("x")
+    _CACHE["last"] = x                    # JPH006
+    return x.item()                       # JPH005
+
+
+@jax.jit
+def float_on_tracer(x):
+    return float(x)                       # JPH005

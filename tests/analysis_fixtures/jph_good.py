@@ -1,0 +1,24 @@
+"""Known-good JPH fixture: the same host effects OUTSIDE jit
+reachability are fine."""
+
+import os
+import time
+
+import jax
+
+_CACHE = {}
+
+
+def host_wrapper(x):
+    # host code may do all of this freely
+    t0 = time.perf_counter()
+    os.environ.get("ANY_VAR", "")
+    out = traced(x)
+    _CACHE["last_ms"] = (time.perf_counter() - t0) * 1e3
+    print("done")
+    return float(out[0])
+
+
+@jax.jit
+def traced(x):
+    return x * 2

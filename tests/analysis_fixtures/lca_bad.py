@@ -1,0 +1,13 @@
+"""Known-bad LCA fixture: in-place stores into LaneArena columns
+outside the arena-owning lanecache module."""
+
+
+def clobber_via_alias(view):
+    a = view.arena
+    a.ts[0] = 99            # LCA001: aliased by every sibling view
+    return a
+
+
+def clobber_direct(view, n):
+    view.arena.site[:n] = 0  # LCA001: direct arena-column store
+    return view

@@ -1,0 +1,13 @@
+"""Known-good LCA fixture: reading arena columns and writing into
+fresh local buffers is the sanctioned pattern (wave assembly does
+exactly this)."""
+
+import numpy as np
+
+
+def assemble(view, out):
+    a, n = view.arena, view.n
+    out[:n] = a.ts[:n]          # store target is the local buffer
+    local = np.array(a.site[:n])
+    local[0] = 0                # fresh copy, not the arena
+    return out, local

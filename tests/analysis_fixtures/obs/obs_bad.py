@@ -1,0 +1,12 @@
+"""Known-bad OBS fixture (lives under an ``obs/`` directory, so the
+obs-package rules apply): reading trace switches breaks the obs-off
+zero-reads contract."""
+
+import os
+
+
+def snapshot():
+    bad = os.environ.get("CAUSE_TPU_SORT", "")      # OBS001 (literal)
+    key = "CAUSE_TPU" + "_GATHER"
+    worse = os.environ.get(key, "")                  # OBS001 (opaque)
+    return bad, worse

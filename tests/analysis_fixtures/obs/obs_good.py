@@ -1,0 +1,10 @@
+"""Known-good OBS fixture: obs reading its OWN knobs by literal name
+is the sanctioned pattern."""
+
+import os
+
+
+def state():
+    on = os.environ.get("CAUSE_TPU_OBS", "")
+    out = os.environ.get("CAUSE_TPU_OBS_OUT", "")
+    return on, out

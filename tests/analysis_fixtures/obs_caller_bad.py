@@ -1,0 +1,12 @@
+"""Known-bad OBS002 fixture: unguarded obs API on a traced path."""
+
+import jax
+
+from cause_tpu import obs
+
+
+@jax.jit
+def traced(x):
+    obs.flush()                   # OBS002: unconditional work
+    with obs.span("ok.guarded"):  # fine: no-op factory
+        return x * 2

@@ -1,0 +1,6 @@
+"""Suppression fixture: both syntaxes neutralize a real finding."""
+
+SAME_LINE = {"CAUSE_TPU_SORT": "x"}  # causelint: disable=TID002 -- fixture: same-line suppression
+# causelint: disable-next-line=TID -- fixture: family token on next line
+NEXT_LINE = {"CAUSE_TPU_GATHER": "y"}
+NOT_SUPPRESSED = {"CAUSE_TPU_SEARCH": "z"}  # causelint: disable=JPH001 -- wrong family: must NOT suppress

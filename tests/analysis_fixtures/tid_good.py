@@ -1,0 +1,36 @@
+"""Known-good TID fixture: the sanctioned patterns stay silent."""
+
+from functools import lru_cache, partial
+
+import jax
+
+from cause_tpu.switches import TRACE_SWITCHES, raw_switch_key, resolve
+
+
+@jax.jit
+def traced_reads_registered(x):
+    # registered switch through the sanctioned helper: clean
+    if resolve("CAUSE_TPU_SORT") == "matrix":
+        return x * 2
+    return x
+
+
+def imported_not_restated():
+    # iterating the imported registry is the blessed pattern
+    return [k for k in TRACE_SWITCHES]
+
+
+@lru_cache(maxsize=4)
+def make_cached_program(k_max, switches):
+    # the switch snapshot is part of the cache key: clean
+    @partial(jax.jit, static_argnames=())
+    def step(x):
+        if resolve("CAUSE_TPU_SORT") == "matrix":
+            return x * 2
+        return x
+
+    return step
+
+
+def build(k_max):
+    return make_cached_program(k_max, raw_switch_key())

@@ -1,0 +1,321 @@
+"""causelint (cause_tpu.analysis) — rule families, suppressions,
+reporters, CLI gating, and the shipped-tree zero-findings contract.
+
+Fixture modules live in tests/analysis_fixtures/ and are parsed, never
+imported: the analyzer is AST-only, which is also why every test here
+is cheap (no jax tracing anywhere).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from cause_tpu.analysis import core, report
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FIX = os.path.join(HERE, "analysis_fixtures")
+
+
+def run_api(*paths, root=REPO):
+    return core.run([os.path.join(FIX, p) if not os.path.isabs(p)
+                     and not os.path.exists(p) else p for p in paths],
+                    root=root)
+
+
+def run_cli(*args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "cause_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=cwd, timeout=120,
+    )
+
+
+def rules_of(result):
+    return sorted({f.rule for f in result.findings})
+
+
+# ------------------------------------------------------- rule families
+
+def test_tid_bad_fixture():
+    res = run_api(os.path.join(FIX, "tid_bad.py"))
+    rules = rules_of(res)
+    assert "TID001" in rules and "TID002" in rules and "TID003" in rules
+    tid1 = [f for f in res.findings if f.rule == "TID001"]
+    # both the traced unregistered read and the helper misuse
+    assert len(tid1) == 2
+    tid3 = [f for f in res.findings if f.rule == "TID003"]
+    assert "make_cached_program" in tid3[0].message
+
+
+def test_tid_good_fixture_is_clean():
+    res = run_api(os.path.join(FIX, "tid_good.py"))
+    assert res.findings == []
+
+
+def test_jph_bad_fixture():
+    res = run_api(os.path.join(FIX, "jph_bad.py"))
+    rules = rules_of(res)
+    for expected in ("JPH001", "JPH002", "JPH003", "JPH004", "JPH005",
+                     "JPH006"):
+        assert expected in rules, (expected, rules)
+    # float() on a traced parameter is JPH005 too
+    jph5 = [f for f in res.findings if f.rule == "JPH005"]
+    assert len(jph5) == 2
+
+
+def test_jph_good_fixture_is_clean():
+    res = run_api(os.path.join(FIX, "jph_good.py"))
+    assert res.findings == []
+
+
+def test_obs_bad_fixture():
+    res = run_api(os.path.join(FIX, "obs", "obs_bad.py"))
+    obs1 = [f for f in res.findings if f.rule == "OBS001"]
+    # one literal TRACE_SWITCHES read + one unprovable non-literal key
+    assert len(obs1) == 2
+
+
+def test_obs_good_fixture_is_clean():
+    res = run_api(os.path.join(FIX, "obs", "obs_good.py"))
+    assert res.findings == []
+
+
+def test_obs_unguarded_call_on_traced_path():
+    res = run_api(os.path.join(FIX, "obs_caller_bad.py"))
+    obs2 = [f for f in res.findings if f.rule == "OBS002"]
+    # exactly one: flush() flagged, the guarded span() factory is not
+    assert len(obs2) == 1
+    assert obs2[0].message.startswith("obs.flush()")
+
+
+def test_lca_bad_fixture():
+    res = run_api(os.path.join(FIX, "lca_bad.py"))
+    lca = [f for f in res.findings if f.rule == "LCA001"]
+    assert len(lca) == 2  # aliased store + direct .arena.col store
+
+
+def test_lca_good_fixture_is_clean():
+    res = run_api(os.path.join(FIX, "lca_good.py"))
+    assert res.findings == []
+
+
+# -------------------------------------------------------- suppressions
+
+def test_suppressions_same_line_and_next_line():
+    res = run_api(os.path.join(FIX, "suppressed.py"))
+    # two real violations neutralized; the wrong-family token does not
+    # suppress the TID002 AND is itself reported as a stale
+    # suppression (GEN002) on the full-rule run
+    assert len(res.suppressed) == 2
+    assert rules_of(res) == ["GEN002", "TID002"]
+    tid = [f for f in res.findings if f.rule == "TID002"]
+    assert "CAUSE_TPU_SEARCH" in tid[0].snippet
+
+
+def test_unused_suppression_only_reported_on_full_runs():
+    res = core.run([os.path.join(FIX, "suppressed.py")], root=REPO,
+                   rule_ids=["TID002"])
+    # under a rule subset, "unused" just means "rule not run"
+    assert rules_of(res) == ["TID002"]
+
+
+def test_suppression_inside_string_is_inert(tmp_path):
+    # the suppression-syntax EXAMPLE inside the string literal sits on
+    # the line right above the real violation: a raw line-regex parser
+    # would treat it as live and shield the finding; the tokenizing
+    # parser only honors real comments
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        'DOC = """example:\n'
+        '# causelint: disable-next-line=TID002 -- just an example\n'
+        '"""; FLIP = {"CAUSE_TPU_SORT": "matrix"}\n'
+    )
+    res = core.run([str(mod)], root=str(tmp_path))
+    assert rules_of(res) == ["TID002"]
+    assert res.suppressed == []
+
+
+def test_suppression_parser():
+    supps = core.parse_suppressions([
+        'x = 1  # causelint: disable=TID002 -- why not',
+        '# causelint: disable-next-line=JPH001,JPH002',
+        'y = 2',
+    ])
+    assert supps[1][0].tokens == {"TID002"}
+    assert supps[1][0].reason == "why not"
+    assert supps[3][0].tokens == {"JPH001", "JPH002"}
+
+
+# -------------------------------------------------- reachability depth
+
+def test_transitive_reachability(tmp_path):
+    (tmp_path / "mod.py").write_text(textwrap.dedent("""\
+        import os
+        import jax
+
+        def helper(x):
+            return os.environ.get("HELPER_VAR", "")
+
+        @jax.jit
+        def traced(x):
+            return helper(x)
+
+        def host_only(x):
+            return os.environ.get("HOST_VAR", "")
+    """))
+    res = core.run([str(tmp_path / "mod.py")], root=str(tmp_path))
+    jph1 = [f for f in res.findings if f.rule == "JPH001"]
+    assert len(jph1) == 1
+    assert "HELPER_VAR" in jph1[0].message  # flagged through the call
+    assert not any("HOST_VAR" in f.message for f in res.findings)
+
+
+# ------------------------------------------------------ JSON reporter
+
+def test_json_reporter_schema():
+    out = run_cli(os.path.join(FIX, "jph_bad.py"), "--format", "json")
+    assert out.returncode == 1
+    data = json.loads(out.stdout)
+    for key in ("version", "tool", "files", "total", "suppressed",
+                "baseline_filtered", "counts", "findings"):
+        assert key in data, key
+    assert data["tool"] == "causelint" and data["version"] == 1
+    assert data["total"] == len(data["findings"]) > 0
+    assert sum(data["counts"].values()) == data["total"]
+    for f in data["findings"]:
+        for key in ("rule", "family", "path", "line", "col", "message",
+                    "snippet", "fingerprint"):
+            assert key in f, key
+        assert f["rule"].startswith(f["family"])
+
+
+# ------------------------------------------------------- CLI contract
+
+def test_cli_exit_codes():
+    assert run_cli(os.path.join(FIX, "tid_bad.py")).returncode == 1
+    assert run_cli(os.path.join(FIX, "tid_good.py")).returncode == 0
+    assert run_cli("/nonexistent/nope.py").returncode == 2
+    assert run_cli(".", "--rules", "NOT_A_RULE").returncode == 2
+
+
+@pytest.mark.parametrize("fixture", [
+    "tid_bad.py", "jph_bad.py", os.path.join("obs", "obs_bad.py"),
+    "obs_caller_bad.py", "lca_bad.py",
+])
+def test_cli_gates_each_known_bad_fixture(fixture):
+    assert run_cli(os.path.join(FIX, fixture)).returncode == 1
+
+
+def test_cli_list_rules():
+    out = run_cli("--list-rules")
+    assert out.returncode == 0
+    for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
+                "OBS001", "OBS002", "LCA001", "GEN001"):
+        assert rid in out.stdout
+
+
+def test_cli_works_without_jax_or_numpy(tmp_path):
+    """The CI lint job runs from a bare checkout before the test
+    matrix installs anything: block jax AND numpy outright and the
+    CLI must still analyze the whole tree."""
+    script = tmp_path / "blocked.py"
+    script.write_text(textwrap.dedent("""\
+        import os
+        import sys
+
+        sys.path.insert(0, os.getcwd())
+
+        class Blocker:
+            def find_spec(self, name, path=None, target=None):
+                if name.split(".")[0] in ("jax", "jaxlib", "numpy"):
+                    raise ImportError("BLOCKED: " + name)
+                return None
+
+        sys.meta_path.insert(0, Blocker())
+        sys.argv = ["causelint", "cause_tpu", "scripts", "bench.py"]
+        import runpy
+        runpy.run_module("cause_tpu.analysis", run_name="__main__")
+    """))
+    out = subprocess.run([sys.executable, str(script)],
+                         capture_output=True, text=True, cwd=REPO,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "0 finding(s)" in out.stdout
+
+
+# ----------------------------------------------------------- baseline
+
+def test_baseline_freezes_existing_findings_only(tmp_path):
+    mod = tmp_path / "mod.py"
+    base = tmp_path / "base.json"
+    with open(os.path.join(FIX, "tid_bad.py")) as f:
+        mod.write_text(f.read())
+    wrote = run_cli(str(mod), "--write-baseline", str(base))
+    assert wrote.returncode == 0
+    frozen = json.loads(base.read_text())
+    assert frozen["fingerprints"]
+    # frozen findings no longer gate
+    assert run_cli(str(mod), "--baseline", str(base)).returncode == 0
+    # a NEW violation still does (and line shifts don't unfreeze)
+    mod.write_text("X_NEW = 0\n" + mod.read_text()
+                   + '\nNEW = {"CAUSE_TPU_SCATTER": "hint"}\n')
+    out = run_cli(str(mod), "--baseline", str(base))
+    assert out.returncode == 1
+    assert "CAUSE_TPU_SCATTER" in out.stdout
+    assert out.stdout.count(": TID") == 1  # only the new one
+
+
+def test_missing_baseline_is_empty(tmp_path):
+    fps = report.load_baseline(str(tmp_path / "absent.json"))
+    assert fps == set()
+
+
+def test_rules_gen_only_runs_no_family_rules():
+    """--rules GEN001 selects the driver's parse check alone — it must
+    NOT silently expand to every rule (empty selection != full run)."""
+    out = run_cli(os.path.join(FIX, "tid_bad.py"), "--rules", "GEN001")
+    assert out.returncode == 0, out.stdout
+    assert "TID" not in out.stdout
+
+
+def test_duplicate_lines_get_distinct_fingerprints(tmp_path):
+    """Freezing one occurrence of a flagged line must not baseline a
+    LATER identical copy of it: duplicates carry an occurrence index."""
+    mod = tmp_path / "mod.py"
+    base = tmp_path / "base.json"
+    line = 'F = {"CAUSE_TPU_SORT": "matrix"}\n'
+    mod.write_text(line)
+    assert run_cli(str(mod), "--write-baseline",
+                   str(base)).returncode == 0
+    assert run_cli(str(mod), "--baseline", str(base)).returncode == 0
+    mod.write_text(line + line)  # a new identical violation
+    out = run_cli(str(mod), "--baseline", str(base))
+    assert out.returncode == 1
+    assert out.stdout.count("TID002") == 1  # only the new copy gates
+
+
+# -------------------------------------------- the shipped-tree ratchet
+
+def test_shipped_tree_has_zero_findings():
+    """The acceptance gate: the tree causelint ships with is clean
+    (every intentional exception carries an explicit suppression with
+    a reason)."""
+    res = core.run([os.path.join(REPO, "cause_tpu"),
+                    os.path.join(REPO, "scripts"),
+                    os.path.join(REPO, "bench.py")], root=REPO)
+    assert res.findings == [], [
+        f"{f.path}:{f.line} {f.rule} {f.message}" for f in res.findings]
+    # the recorded exceptions all carry a reason string
+    assert len(res.suppressed) >= 9
+
+
+def test_syntax_error_becomes_gen_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    res = core.run([str(bad)], root=str(tmp_path))
+    assert rules_of(res) == ["GEN001"]
+    assert res.exit_code == 1

@@ -158,3 +158,21 @@ def test_scalar_program_cache_key_xla_collapse(monkeypatch):
     assert bg_mod.merge_wave_scalar(
         1, k_max=7, kernel="v5", u_max=7) == "forced-xla"
     assert len(hits) == 1 and len(probe) == 1
+
+
+def test_raw_switch_key_matches_program_cache_shape(monkeypatch):
+    """merge_wave_scalar (and the mesh sharded-step caches) key on
+    switches.raw_switch_key(): one raw_key value per TRACE_SWITCHES
+    member, in registry order — the exact tuple the cache-hit tests
+    above construct by hand. Pins the helper so the two can't drift."""
+    from cause_tpu import switches
+
+    for k in switches.TRACE_SWITCHES:
+        monkeypatch.delenv(k, raising=False)
+    assert switches.raw_switch_key() == ("",) * len(
+        switches.TRACE_SWITCHES)
+    monkeypatch.setenv("CAUSE_TPU_GATHER", "rowgather")
+    key = switches.raw_switch_key()
+    gi = switches.TRACE_SWITCHES.index("CAUSE_TPU_GATHER")
+    assert key[gi] == "rowgather"
+    assert all(v == "" for i, v in enumerate(key) if i != gi)

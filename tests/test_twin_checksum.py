@@ -83,6 +83,36 @@ def test_corrupted_twin_interior_is_detected():
     assert c1 and not o1
 
 
+def test_cross_row_digest_is_row_position_sensitive():
+    """ADVICE r5 #4: the bench scalar's cross-row combination was a
+    plain modular sum of row digests — permutation-invariant across
+    rows, so compensating per-row errors (the canonical case: two rows
+    swapped) cancelled to the same scalar. Each row digest is now
+    rotated by ``row & 31`` before the sum: swapping two distinct rows
+    MUST change the scalar, while re-running the same batch must not."""
+    batch = benchgen.batched_pair_lanes(
+        n_replicas=2, n_base=12, n_div=4, capacity=64, hide_every=3
+    )
+    v5 = benchgen.batched_v5_inputs(batch, 64)
+    u = benchgen.v5_token_budget(v5)
+
+    def scalar(b):
+        out = np.asarray(benchgen.merge_wave_scalar(
+            *(jnp.asarray(b[k]) for k in LANE_KEYS5),
+            k_max=u, kernel="v5", u_max=u,
+        ))
+        assert out[1] == 0, "fixture must not overflow"
+        return int(out[0])
+
+    d0 = scalar(v5)
+    assert scalar(v5) == d0  # deterministic across calls
+    swapped = {k: v[::-1].copy() for k, v in v5.items()}
+    assert scalar(swapped) != d0, (
+        "row-swapped batch produced the same cross-row digest — "
+        "compensating per-row errors would cancel again"
+    )
+
+
 def test_clean_twins_still_dedupe():
     """The checksum must not break wholesale dedupe of HONEST twins:
     token count stays at segment scale, not node scale."""
