@@ -40,6 +40,11 @@ from cause_tpu import obs  # dependency-light (no jax), like switches
 from cause_tpu.switches import TRACE_SWITCHES  # dependency-free
 
 NORTH_STAR_MS = 100.0
+# bench JSON schema: v2 adds "schema_version" itself plus an explicit
+# "fallback": true when the TPU attempt was abandoned — before v2 the
+# only hint was platform "cpu-fallback" with vs_baseline 0.0, which
+# reads like a regression at a glance (the round-2 provenance slip)
+BENCH_SCHEMA_VERSION = 2
 # generous: first XLA compile of the 1024x10k kernel + 4 timed reps
 FULL_TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT", "1500"))
 CPU_TIMEOUT_S = 900.0
@@ -113,6 +118,28 @@ def _run_abandonable(cmd, env, deadline_s, sentinel=None,
         except OSError:
             pass
     return None
+
+
+def _append_to_ledger(artifact_line: str, obs_out: str,
+                      ledger_path: str = "") -> None:
+    """With obs on, every bench artifact also lands in the persistent
+    perf ledger (measurements/ledger.jsonl) with the sidecar's
+    devprof/counter digest. Best-effort: a ledger failure must never
+    cost the bench artifact or its exit code."""
+    if not obs.enabled():
+        return
+    try:
+        from cause_tpu.obs import ledger
+
+        row = ledger.ingest_record(
+            json.loads(artifact_line),
+            source=f"bench.py@{time.strftime('%Y-%m-%d')}",
+            obs_jsonl=obs_out, path=ledger_path or None)
+        print(f"bench: ledger row ({row['platform']}) -> "
+              f"{ledger_path or ledger.default_path()}",
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - best-effort ledger append
+        print(f"bench: ledger append failed ({e})", file=sys.stderr)
 
 
 def _export_obs_trace(obs_out: str) -> None:
@@ -517,7 +544,13 @@ def measure(platform: str) -> dict:
         "vs_target": vs,
         "target_ms": NORTH_STAR_MS,
         "platform": tag,
+        "schema_version": BENCH_SCHEMA_VERSION,
     }
+    if tag == "cpu-fallback":
+        # explicit, machine-checkable: this row exists because the TPU
+        # attempt was abandoned — the ledger quarantines it from every
+        # baseline/regression comparison
+        out["fallback"] = True
     if alt is not None:
         out["other_config_ms"] = round(alt, 3)
     if checksum_deviation:
@@ -627,23 +660,29 @@ def main() -> None:
         rc, out, err = got
         out = out.strip()
         if rc == 0 and out:
-            print(out.splitlines()[-1])
+            line = out.splitlines()[-1]
+            print(line)
             _export_obs_trace(obs_out)
+            _append_to_ledger(line, obs_out)
             return
         tail = (err or "").strip().splitlines()[-1:] or ["?"]
         errors.append(f"{platform}: rc={rc} {tail[0][:200]}")
         print(f"bench: {platform} attempt rc={rc}; trying next",
               file=sys.stderr)
 
-    print(json.dumps({
+    failed_line = json.dumps({
         "metric": "p50 batched merge+weave (all attempts failed)",
         "value": None,
         "unit": "ms",
         "vs_baseline": 0.0,
         "platform": "none",
+        "schema_version": BENCH_SCHEMA_VERSION,
+        "fallback": True,
         "error": "; ".join(errors)[:500],
-    }))
+    })
+    print(failed_line)
     _export_obs_trace(obs_out)
+    _append_to_ledger(failed_line, obs_out)
 
 
 if __name__ == "__main__":

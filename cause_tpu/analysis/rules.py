@@ -514,6 +514,128 @@ def check_obs002(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                 "event(), which collapse to shared no-ops")
 
 
+_DEVPROF_APIS = frozenset(
+    {"profile_program", "program_cost", "sample_device_memory",
+     "arena_footprint"}
+)
+
+
+def _is_enabled_name(name: str) -> bool:
+    """The sanctioned guard in any of the repo's import spellings:
+    ``obs.enabled()``, ``devprof.enabled()``, or the aliased
+    ``from ..obs import enabled as _obs_enabled`` style lanecache
+    uses — matching only the literal ``enabled`` would flag
+    correctly-guarded code the moment an aliasing module becomes
+    jit-reachable."""
+    return name == "enabled" or name.endswith("_enabled")
+
+
+def _mentions_enabled(test: ast.expr) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            parts = dotted_parts(n.func)
+            if parts and _is_enabled_name(parts[-1]):
+                return True
+    return False
+
+
+def _is_not_enabled_exit(s: ast.stmt) -> bool:
+    """``if not ...enabled(): return/raise/continue/break`` — the
+    early-return guard style; everything after it in the same
+    statement list only runs with obs on."""
+    return (isinstance(s, ast.If) and not s.orelse
+            and isinstance(s.test, ast.UnaryOp)
+            and isinstance(s.test.op, ast.Not)
+            and _mentions_enabled(s.test.operand)
+            and bool(s.body)
+            and isinstance(s.body[-1], (ast.Return, ast.Raise,
+                                        ast.Continue, ast.Break)))
+
+
+def _calls_with_guards(info: FuncInfo):
+    """(Call node, guarded) pairs over one scope's own statements,
+    where ``guarded`` means the call sits inside the body of an
+    ``if ...enabled()...:`` test, or after an
+    ``if not ...enabled(): return`` early exit in the same statement
+    list. Nested function/lambda bodies are their own scopes (they
+    get their own FuncInfo)."""
+
+    def walk_stmts(stmts, guarded):
+        for s in stmts:
+            yield from walk(s, guarded)
+            if _is_not_enabled_exit(s):
+                guarded = True
+
+    def walk(n, guarded):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            return
+        if isinstance(n, ast.Call):
+            yield n, guarded
+        if isinstance(n, ast.If):
+            # polarity matters: `if enabled():` guards the BODY,
+            # `if not enabled():` guards the ELSE branch — marking
+            # both bodies guarded whenever the test mentions enabled()
+            # would sanction obs-off-only code and flag the correctly
+            # guarded else of a negated test
+            if (isinstance(n.test, ast.UnaryOp)
+                    and isinstance(n.test.op, ast.Not)
+                    and _mentions_enabled(n.test.operand)):
+                body_g, else_g = guarded, True
+            elif _mentions_enabled(n.test):
+                body_g, else_g = True, guarded
+            else:
+                body_g = else_g = guarded
+            yield from walk(n.test, guarded)
+            yield from walk_stmts(n.body, body_g)
+            yield from walk_stmts(n.orelse, else_g)
+            return
+        for name, value in ast.iter_fields(n):
+            if name in ("body", "orelse", "finalbody") \
+                    and isinstance(value, list):
+                yield from walk_stmts(value, guarded)
+                continue
+            for c in (value if isinstance(value, list) else [value]):
+                if isinstance(c, ast.AST):
+                    yield from walk(c, guarded)
+
+    if isinstance(info.node.body, list):
+        yield from walk_stmts(info.node.body, False)
+    else:
+        yield from walk(info.node.body, False)
+
+
+@rule("OBS003",
+      "devprof API reached from jit-reachable code without an "
+      "obs.enabled() guard (device-program telemetry samples live "
+      "arrays and AOT-compiles the moment obs is on)")
+def check_obs003(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]):
+                # devprof.enabled() IS the sanctioned guard — flagging
+                # it would gate the exact pattern the docs prescribe
+                continue
+            is_devprof = (
+                parts[-1] in _DEVPROF_APIS
+                or any(p in ("devprof", "_devprof")
+                       for p in parts[:-1])
+            )
+            if is_devprof and not guarded:
+                yield _finding(
+                    "OBS003", module, call,
+                    f"devprof.{parts[-1]}() on a jit-reachable path "
+                    "without an obs.enabled() guard — unlike the "
+                    "no-op span/counter factories, devprof does real "
+                    "work when obs is on; gate the call (or hoist it "
+                    "off the traced path)")
+
+
 # ----------------------------------------------------------------- LCA
 
 @rule("LCA001",

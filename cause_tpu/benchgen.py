@@ -325,9 +325,23 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
             def program(*a):
                 return _checksum(*jax.vmap(merge_weave_kernel)(*a))
 
-        _scalar_programs[key] = program
         with _obs_span("program.build", kernel=key[1],
                        k_max=int(k_max), u_max=int(u_max)):
+            from .obs import devprof as _devprof
+
+            if _devprof.enabled():
+                # one-per-compiled-program device cost capture: route
+                # THIS first compile through the AOT path so the
+                # executable's cost_analysis lands as a devprof event
+                # keyed like the cache key (no second compile; obs-off
+                # never reaches here and the cache stores the plain
+                # jit program exactly as before)
+                prof = _devprof.profile_program(
+                    program, args, kernel=key[1], k_max=int(k_max),
+                    u_max=int(u_max))
+                if prof is not None:
+                    program = prof
+            _scalar_programs[key] = program
             return program(*args)
     _obs_counter("program_cache.hit").inc()
     return program(*args)

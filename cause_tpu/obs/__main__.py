@@ -1,11 +1,15 @@
-"""CLI: convert an obs JSONL event stream to a Perfetto trace.
+"""CLI: the obs toolbox.
 
-    python -m cause_tpu.obs events.jsonl -o trace.json
+    python -m cause_tpu.obs events.jsonl -o trace.json   # Perfetto
+    python -m cause_tpu.obs stages [--smoke] [--reps N]  # stage ladder
+    python -m cause_tpu.obs ledger --check               # perf ledger
 
-Open the output at https://ui.perfetto.dev (or chrome://tracing).
-With ``--summary`` it also prints per-span-name aggregate wall times
-and the final counter values — the quick look before reaching for the
-viewer.
+The default (first) form converts an obs JSONL event stream to a
+Perfetto trace — open the output at https://ui.perfetto.dev (or
+chrome://tracing); with ``--summary`` it also prints per-span-name
+aggregate wall times and the final counter values. ``stages`` runs
+the jaxw5 stage-prefix profiler (``cause_tpu.obs.stages``); ``ledger``
+manages the persistent perf ledger (``cause_tpu.obs.ledger``).
 """
 
 from __future__ import annotations
@@ -14,10 +18,24 @@ import argparse
 import json
 import sys
 
-from .perfetto import export_perfetto, load_jsonl
+from .perfetto import export_perfetto, load_jsonl, merged_final_counters
 
 
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "stages":
+        # imports jax — resolved only when asked for
+        from .stages import main as stages_main
+
+        return stages_main(argv[1:])
+    if argv and argv[0] == "ledger":
+        from .ledger import main as ledger_main
+
+        return ledger_main(argv[1:])
+    return _convert_main(argv)
+
+
+def _convert_main(argv) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m cause_tpu.obs",
         description="Convert obs JSONL events to a Perfetto/Chrome "
@@ -38,24 +56,12 @@ def main(argv=None) -> int:
 
     if a.summary:
         agg: dict = {}
-        # counter snapshots are cumulative PER PROCESS: keep each
-        # pid's last snapshot and sum across pids (a shared sidecar
-        # interleaves parent + abandoned-child flushes — last-wins
-        # across pids would report whichever process flushed last)
-        per_pid: dict = {}
         for e in events:
             if e.get("ev") == "span":
                 name = e.get("name", "?")
                 tot, cnt = agg.get(name, (0, 0))
                 agg[name] = (tot + e.get("dur_us", 0), cnt + 1)
-            elif e.get("ev") == "counters":
-                merged = dict(e.get("counters") or {})
-                merged.update(e.get("gauges") or {})
-                per_pid[e.get("pid", 0)] = merged
-        counters: dict = {}
-        for snap in per_pid.values():
-            for name, value in snap.items():
-                counters[name] = counters.get(name, 0) + value
+        counters = merged_final_counters(events, include_gauges=True)
         for name in sorted(agg, key=lambda n_: -agg[n_][0]):
             tot, cnt = agg[name]
             print(json.dumps({"span": name, "total_ms":

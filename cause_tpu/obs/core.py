@@ -359,9 +359,22 @@ class _Gauge:
         self.name = name
 
     def set(self, value):
+        """Record the new value in the registry AND as a timestamped
+        ``gauge`` event — a gauge is a sampled time series (devprof
+        memory curves), so each set must land in the stream, not just
+        in the flush-time snapshot (which only has flush resolution)."""
         st = self.st
+        rec = {
+            "ev": "gauge",
+            "name": self.name,
+            "ts_us": time.time_ns() // 1000,
+            "pid": os.getpid(),
+            "platform": st.platform,
+            "value": value,
+        }
         with st.lock:
             st.gauges[self.name] = value
+            st.record(rec)
         return self
 
     @property

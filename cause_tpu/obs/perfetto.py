@@ -10,7 +10,10 @@ chrome://tracing) open directly:
 - ``event`` records become instant events ("ph": "i", thread scope);
 - ``counters`` snapshots become one counter track per metric
   ("ph": "C"), so program-cache hit/miss rates and fallback counts
-  plot as time series next to the spans they explain.
+  plot as time series next to the spans they explain;
+- ``gauge`` records (every ``obs.gauge(...).set()``) become counter
+  -track samples too, at set-time resolution — devprof's live-array
+  and device-memory gauges render as curves, not flush-time steps.
 
 Stdlib-only, like the rest of ``cause_tpu.obs``.
 """
@@ -20,7 +23,8 @@ from __future__ import annotations
 import json
 from typing import Iterable, List, Optional
 
-__all__ = ["to_chrome_trace", "export_perfetto", "load_jsonl"]
+__all__ = ["to_chrome_trace", "export_perfetto", "load_jsonl",
+           "merged_final_counters"]
 
 
 def load_jsonl(path: str) -> List[dict]:
@@ -38,6 +42,29 @@ def load_jsonl(path: str) -> List[dict]:
                 continue
             if isinstance(obj, dict):
                 out.append(obj)
+    return out
+
+
+def merged_final_counters(events: Iterable[dict],
+                          include_gauges: bool = False) -> dict:
+    """The stream's final counter values: counter snapshots are
+    cumulative PER PROCESS, so keep each pid's LAST snapshot and sum
+    across pids (a shared sidecar interleaves parent + abandoned-child
+    flushes — last-wins across pids would report whichever process
+    flushed last). The one merge rule shared by ``--summary`` and the
+    ledger's devprof digest."""
+    per_pid: dict = {}
+    for e in events:
+        if e.get("ev") != "counters":
+            continue
+        merged = dict(e.get("counters") or {})
+        if include_gauges:
+            merged.update(e.get("gauges") or {})
+        per_pid[e.get("pid", 0)] = merged
+    out: dict = {}
+    for snap in per_pid.values():
+        for name, value in snap.items():
+            out[name] = out.get(name, 0) + value
     return out
 
 
@@ -84,6 +111,15 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
                 "pid": pid,
                 "tid": e.get("tid", 0),
                 "args": args,
+            })
+        elif ev == "gauge":
+            trace.append({
+                "name": e.get("name", "?"),
+                "cat": "obs",
+                "ph": "C",
+                "ts": e.get("ts_us", 0),
+                "pid": pid,
+                "args": {"value": e.get("value", 0)},
             })
         elif ev == "counters":
             ts = e.get("ts_us", 0)

@@ -186,6 +186,10 @@ class FleetSession:
         ]
         self._gen = views[0][0].interner.generation
         self.pairs = list(pairs)
+        if obs.enabled():
+            from ..obs import devprof
+
+            devprof.sample_device_memory("session.upload")
 
     # ------------------------------------------------------------------
     def update(self, pairs: Sequence[Tuple[object, object]]):
@@ -328,6 +332,12 @@ class FleetSession:
             self.last_visible = v
             self.last_overflow = ov
             out = np.asarray(digest)
+        if obs.enabled():
+            # wave-boundary devprof sample: the session's whole point
+            # is device residency, so its growth must be a curve
+            from ..obs import devprof
+
+            devprof.sample_device_memory("session")
         if bool(np.asarray(ov).any()):
             raise s.CausalError(
                 "wave overflowed the session's token budget; raise "

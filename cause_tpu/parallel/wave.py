@@ -523,6 +523,13 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
     obs.counter("wave.pairs").inc(B)
     obs.counter("wave.fallback").inc(len(fallback))
     obs.counter("wave.poisoned").inc(len(poisoned))
+    if obs.enabled():
+        # devprof wave-boundary sample: live device arrays + backend
+        # memory after the dispatch settle, so per-wave residency
+        # renders as a curve next to the dispatch spans
+        from ..obs import devprof
+
+        devprof.sample_device_memory("wave")
     return WaveResult(pairs, views, cap, full_rank, full_vis, full_dig,
                       fallback, pipeline, dig_valid,
                       poisoned=poisoned)

@@ -39,6 +39,7 @@ KNOWN_ENV_KNOBS = (
     "CAUSE_TPU_DEFAULTS_FILE",
     "CAUSE_TPU_NATIVE_CACHE",
     "CAUSE_TPU_BODY_SAMPLE",
+    "CAUSE_TPU_LEDGER",
 )
 
 # The XLA-only streaming candidate combination ("beststream"): the

@@ -55,7 +55,7 @@ from .arrays import (
     next_pow2,
 )
 from ..ids import is_id
-from ..obs import counter as _obs_counter
+from ..obs import counter as _obs_counter, enabled as _obs_enabled
 
 __all__ = [
     "SharedInterner",
@@ -346,7 +346,15 @@ def build_view(nodes_map: dict, uuid: str,
     )
     if not na.spec_ok:
         return None
-    return LaneView(_arena_from_node_arrays(na, interner, gen), na.n)
+    view = LaneView(_arena_from_node_arrays(na, interner, gen), na.n)
+    if _obs_enabled():
+        # devprof host-memory telemetry: a from-scratch marshal is the
+        # expensive rebuild path, so its arena footprint is the curve
+        # that shows fleet-cache growth in a trace
+        from ..obs import devprof as _devprof
+
+        _devprof.arena_footprint(view.arena, site="lanecache.build")
+    return view
 
 
 def _copy_arena(view: LaneView, min_capacity: int) -> LaneArena:

@@ -1,11 +1,13 @@
 """Cumulative-prefix phase timing of the v5 kernel on real hardware.
 
-Runs the kernel truncated at each stage checkpoint (jaxw5
-``stage=`` early returns, each checksumming its live outputs so XLA
-cannot DCE the prefix) at the north-star bench shape, and prints the
-per-stage increments. This is the measurement probe probe_v5.py's
-isolated re-implementations can't give: the *actual* compiled prefix
-cost, gathers, vmap batching and all.
+DEPRECATED thin wrapper: the stage ladder now lives in
+``cause_tpu.obs.stages`` (run ``python -m cause_tpu.obs stages`` for
+the same measurement with the obs sidecar flags). This script keeps
+its historical CLI (``--smoke``/``--reps``/``--allstream``) and stdout
+format for the measurement queue's existing invocations, but owns no
+timing code anymore — every number comes through the shared obs stage
+profiler, so stage deltas land in the same JSONL/Perfetto stream as
+bench and wave spans when ``CAUSE_TPU_OBS=1``.
 
 Stages: A segment ordering + explode/dedupe; B token construction;
 C token sort + dedupe; D cause resolution (binary search + host walk);
@@ -19,22 +21,11 @@ from __future__ import annotations
 import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
 
 import argparse
-import time
 
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-from cause_tpu import benchgen
-from cause_tpu.benchgen import LANE_KEYS5
-from cause_tpu.weaver.jaxw5 import merge_weave_kernel_v5
+from cause_tpu.obs.stages import run_v5_stage_ladder
 
 
 def main():
-    from cause_tpu.benchgen import enable_compile_cache
-
-    enable_compile_cache()
     ap = argparse.ArgumentParser()
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--reps", type=int, default=3)
@@ -42,71 +33,8 @@ def main():
                     help="profile the streaming configuration "
                          "(rowgather + bitonic + matrix search)")
     a = ap.parse_args()
-    if a.allstream:
-        import os
-
-        # deliberate A/B flip of this probe's own child config (NOT
-        # the beststream candidate — the stage probe wants the bitonic
-        # sort specifically), so the restated names are intentional
-        os.environ["CAUSE_TPU_SORT"] = "bitonic"  # causelint: disable=TID002 -- probe flips its own A/B config
-        os.environ["CAUSE_TPU_GATHER"] = "rowgather"  # causelint: disable=TID002 -- probe flips its own A/B config
-        os.environ["CAUSE_TPU_SEARCH"] = "matrix"  # causelint: disable=TID002 -- probe flips its own A/B config
-    if a.smoke:
-        B, NB, ND, CAP = 8, 800, 100, 1024
-    else:
-        B, NB, ND, CAP = 1024, 9_000, 1_000, 10_240
-
-    print(f"platform={jax.devices()[0].platform} B={B} cap={CAP}",
-          flush=True)
-    batch = benchgen.batched_pair_lanes(
-        n_replicas=B, n_base=NB, n_div=ND, capacity=CAP, hide_every=8
-    )
-    v5 = benchgen.batched_v5_inputs(batch, CAP)
-    u = benchgen.v5_token_budget(v5)
-    print(f"u_budget={u} S={v5['sg_len'].shape[1]} "
-          f"N={v5['hi'].shape[1]}", flush=True)
-    dev = {k: jax.device_put(v5[k]) for k in LANE_KEYS5}
-    args = [dev[k] for k in LANE_KEYS5]
-
-    progs = {}
-
-    def prog_for(stage):
-        if stage not in progs:
-            def row(*xs):
-                out = merge_weave_kernel_v5(*xs, u_max=u, k_max=u,
-                                            stage=stage)
-                if stage is None:
-                    rank, visible, conflict, overflow = out
-                    return (jnp.sum(rank.astype(jnp.float32))
-                            + jnp.sum(visible.astype(jnp.float32))
-                            + conflict.astype(jnp.float32)
-                            + overflow.astype(jnp.float32))
-                return out
-
-            progs[stage] = jax.jit(
-                lambda *xs: jnp.sum(jax.vmap(row)(*xs))
-            )
-        return progs[stage]
-
-    prev = 0.0
-    for stage in ("A", "B", "C", "D", "E", None):
-        p = prog_for(stage)
-        try:
-            np.asarray(p(*args))  # compile + warm
-            ts = []
-            for _ in range(a.reps):
-                t0 = time.perf_counter()
-                np.asarray(p(*args))
-                ts.append((time.perf_counter() - t0) * 1000.0)
-            med = float(np.median(ts))
-            name = stage or "FULL"
-            print(f"prefix->{name:4s} {med:9.1f} ms   "
-                  f"(+{med - prev:8.1f} ms)", flush=True)
-            prev = med
-        except Exception as e:  # noqa: BLE001 - keep probing
-            print(f"prefix->{stage or 'FULL'} FAILED "
-                  f"{type(e).__name__}: {str(e).splitlines()[0][:120]}",
-                  flush=True)
+    run_v5_stage_ladder(smoke=a.smoke, reps=a.reps,
+                        allstream=a.allstream)
 
 
 if __name__ == "__main__":

@@ -6,6 +6,13 @@ targets the real bottleneck instead of a guess. Each phase is its own
 jitted program whose output reduces to one scalar; the device->host
 fetch of that scalar is the sync point (block_until_ready does not
 block on the axon tunnel).
+
+DEPRECATION NOTE: this script's private timing loop is gone — all
+timing routes through the shared obs stage profiler
+(``cause_tpu.obs.stages.timed_median``), so with ``CAUSE_TPU_OBS=1``
+each phase's warm compile and reps land in the obs JSONL/Perfetto
+stream. The v5 stage ladder equivalent is ``python -m cause_tpu.obs
+stages``; this script remains for the v2-pipeline phase split only.
 """
 
 from __future__ import annotations
@@ -14,7 +21,6 @@ import _bootstrap  # noqa: F401  (repo-root sys.path for checkout runs)
 
 import argparse
 import math
-import time
 
 import numpy as np
 
@@ -24,17 +30,14 @@ from jax import lax
 
 from cause_tpu import benchgen
 from cause_tpu.benchgen import LANE_KEYS
+from cause_tpu.obs.stages import timed_median
 from cause_tpu.weaver import jaxw
 
 
 def timed(name, fn, *args, reps=3):
-    out = np.asarray(fn(*args))  # compile + warm
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = np.asarray(fn(*args))
-        ts.append((time.perf_counter() - t0) * 1000.0)
-    p50 = float(np.median(ts))
+    # the one timing loop lives in cause_tpu.obs.stages; this keeps
+    # only the historical stdout format
+    out, p50, ts = timed_median(name, fn, *args, reps=reps)
     print(f"{name:42s} {p50:10.1f} ms   (reps: {[round(t,1) for t in ts]})")
     return out, p50
 

@@ -1,0 +1,46 @@
+"""Known-bad OBS003 fixture: devprof API on a traced path. Only the
+unguarded call gates — the enabled()-guarded one is the sanctioned
+pattern (wave.py / session.py boundaries)."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu.obs import devprof
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    devprof.sample_device_memory("bad")       # OBS003: unguarded
+    if obs.enabled():
+        devprof.sample_device_memory("okay")  # guarded: fine
+    if devprof.enabled():
+        # the module's own guard spelling (benchgen.py) must not be
+        # flagged as an unguarded devprof call itself
+        devprof.arena_footprint(x, site="okay")
+    if _obs_enabled():
+        # the aliased guard spelling (lanecache.py) is a guard too
+        devprof.arena_footprint(x, site="aliased-okay")
+    return x * 2
+
+
+@jax.jit
+def traced_early_return(x):
+    # the early-return guard style is a guard for the rest of the
+    # scope — devprof can never run here with obs off
+    if not obs.enabled():
+        return x
+    devprof.sample_device_memory("early-return-okay")
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful devprof call), its ELSE branch runs obs
+    # -on only (guarded: fine)
+    if not obs.enabled():
+        devprof.sample_device_memory("obs-off-only")  # OBS003
+    else:
+        devprof.sample_device_memory("else-okay")     # guarded: fine
+    return x

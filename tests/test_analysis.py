@@ -92,6 +92,20 @@ def test_obs_unguarded_call_on_traced_path():
     assert obs2[0].message.startswith("obs.flush()")
 
 
+def test_devprof_unguarded_call_on_traced_path():
+    """OBS003 (PR-4): devprof APIs do real work when obs is on —
+    jit-reachable code must gate them behind obs.enabled(). Exactly
+    two findings — the plain unguarded call and the body of a negated
+    test (obs-off-only, never useful); every guard spelling (nested
+    if, devprof.enabled, aliased import, early return, else of a
+    negated test) is sanctioned."""
+    res = run_api(os.path.join(FIX, "devprof_caller_bad.py"))
+    obs3 = [f for f in res.findings if f.rule == "OBS003"]
+    assert len(obs3) == 2, [f.message for f in obs3]
+    assert all("sample_device_memory" in f.message for f in obs3)
+    assert rules_of(res) == ["OBS003"]
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -204,7 +218,7 @@ def test_cli_exit_codes():
 
 @pytest.mark.parametrize("fixture", [
     "tid_bad.py", "jph_bad.py", os.path.join("obs", "obs_bad.py"),
-    "obs_caller_bad.py", "lca_bad.py",
+    "obs_caller_bad.py", "devprof_caller_bad.py", "lca_bad.py",
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -214,7 +228,7 @@ def test_cli_list_rules():
     out = run_cli("--list-rules")
     assert out.returncode == 0
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
-                "OBS001", "OBS002", "LCA001", "GEN001"):
+                "OBS001", "OBS002", "OBS003", "LCA001", "GEN001"):
         assert rid in out.stdout
 
 

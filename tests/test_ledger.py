@@ -1,0 +1,393 @@
+"""cause_tpu.obs.ledger — the platform-partitioned persistent perf
+ledger.
+
+Pins the PR-4 acceptance contract: strict platform partitioning (rows
+are NEVER compared across different ``platform`` values), fallback
+quarantine (``cpu-fallback`` can't shadow or regress-against TPU),
+backfill of the committed BENCH artifacts and measurement-log bench
+lines with honest platform tags, and the regression verdict — exit
+nonzero on a synthetic deterministic-metric or chip-window wall-time
+regression, exit zero on the repo's real backfilled trajectory.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from cause_tpu.obs import ledger
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _row(platform, value_ms, kernel="v5", smoke=False, source="t",
+         **extra):
+    row = {
+        "schema": ledger.LEDGER_SCHEMA, "kind": "bench",
+        "source": source, "platform": platform, "fallback": False,
+        "smoke": smoke, "kernel": kernel, "config": "default",
+        "metric": "p50 batched merge+weave", "value_ms": value_ms,
+        "quarantined": False,
+    }
+    row.update(extra)
+    return row
+
+
+# ---------------------------------------------------------- normalize
+
+
+def test_normalize_bench_driver_wrapper_fallback():
+    artifact = {
+        "n": 2, "cmd": "python bench.py", "rc": 0, "tail": "...",
+        "parsed": {
+            "metric": "p50 batched merge+weave, 8 pairs [smoke size]",
+            "value": 1.997, "unit": "ms", "vs_baseline": 0.0,
+            "platform": "cpu-fallback",
+        },
+    }
+    row = ledger.normalize_bench(artifact, source="BENCH_r02.json")
+    assert row["platform"] == "cpu-fallback"
+    assert row["fallback"] is True
+    assert row["quarantined"] is True
+    assert row["smoke"] is True
+    assert row["value_ms"] == 1.997
+
+
+def test_normalize_bench_null_parsed_is_quarantined():
+    row = ledger.normalize_bench(
+        {"n": 1, "cmd": "x", "rc": 1, "tail": "boom", "parsed": None},
+        source="BENCH_r01.json")
+    assert row["platform"] == "none"
+    assert row["quarantined"] is True
+
+
+def test_normalize_bench_explicit_fallback_field():
+    """bench schema v2: the explicit fallback flag wins over platform
+    heuristics (and a non-fallback platform stays unquarantined)."""
+    row = ledger.normalize_bench(
+        {"metric": "p50 batched merge+weave", "value": 9.0,
+         "platform": "tpu", "schema_version": 2})
+    assert row["fallback"] is False and row["quarantined"] is False
+    row = ledger.normalize_bench(
+        {"metric": "p50 batched merge+weave", "value": 9.0,
+         "platform": "cpu", "fallback": True, "schema_version": 2})
+    assert row["fallback"] is True and row["quarantined"] is True
+
+
+# ------------------------------------------------------- partitioning
+
+
+def test_check_never_compares_across_platforms():
+    """A catastrophic-looking cpu number next to a healthy tpu row is
+    NOT a regression — different platform, different partition."""
+    verdict = ledger.check(rows=[
+        _row("tpu", 100.0, source="a"),
+        _row("cpu", 99999.0, source="b"),
+        _row("tpu", 101.0, source="c"),
+    ])
+    assert verdict["ok"], verdict["regressions"]
+    assert set(verdict["partitions"]) == {"tpu|full|v5|default",
+                                          "cpu|full|v5|default"}
+
+
+def test_check_never_compares_across_configs():
+    """An A/B config flip (allstream etc.) selects different
+    algorithms — its flops/wall time must not regress-against the
+    default-config baseline (they share platform/smoke/kernel)."""
+    verdict = ledger.check(rows=[
+        _row("tpu", 100.0, source="a",
+             devprof={"flops": 1e6, "bytes_accessed": 1e6}),
+        _row("tpu", 300.0, source="b", config="allstream",
+             devprof={"flops": 9e6, "bytes_accessed": 9e6}),
+    ])
+    assert verdict["ok"], verdict["regressions"]
+    assert set(verdict["partitions"]) == {"tpu|full|v5|default",
+                                          "tpu|full|v5|allstream"}
+
+
+def test_fallback_rows_are_quarantined_from_comparisons():
+    verdict = ledger.check(rows=[
+        _row("cpu-fallback", 100.0, fallback=True, quarantined=True),
+        _row("cpu-fallback", 9000.0, fallback=True, quarantined=True),
+        _row("tpu", 100.0),
+    ])
+    assert verdict["ok"]
+    assert verdict["quarantined"] == 2
+    assert not any(label.startswith("cpu-fallback|")
+                   for label in verdict["partitions"])
+
+
+def test_wall_time_regression_gates_only_on_chip_windows():
+    # tpu: a 2x slide IS a regression
+    bad = ledger.check(rows=[_row("tpu", 100.0, source="before"),
+                             _row("tpu", 200.0, source="after")])
+    assert not bad["ok"]
+    (reg,) = bad["regressions"]
+    assert reg["kind"] == "wall_time" and reg["partition"].startswith(
+        "tpu|")
+    # the identical slide on a host platform is NOT wall-gated
+    ok = ledger.check(rows=[_row("cpu", 100.0), _row("cpu", 200.0)])
+    assert ok["ok"]
+
+
+# ------------------------------------------- deterministic metrics
+
+
+def test_counter_regression_is_deterministic_gate():
+    rows = [
+        _row("cpu", 5.0, smoke=True,
+             counters={"program_cache.miss": 1}),
+        _row("cpu", 5.0, smoke=True,
+             counters={"program_cache.miss": 3}),
+    ]
+    verdict = ledger.check(rows=rows)
+    assert not verdict["ok"]
+    (reg,) = verdict["regressions"]
+    assert reg["kind"] == "counters"
+    assert reg["metric"] == "program_cache.miss"
+    assert (reg["before"], reg["after"]) == (1, 3)
+
+
+def test_devprof_cost_regression_and_tolerance():
+    base = _row("cpu", 5.0, smoke=True,
+                devprof={"flops": 1.0e9, "bytes_accessed": 2.0e9})
+    worse = _row("cpu", 5.0, smoke=True,
+                 devprof={"flops": 2.0e9, "bytes_accessed": 2.0e9})
+    verdict = ledger.check(rows=[base, worse])
+    assert not verdict["ok"]
+    assert verdict["regressions"][0]["kind"] == "devprof"
+    # within tolerance: XLA-version drift must not gate
+    near = _row("cpu", 5.0, smoke=True,
+                devprof={"flops": 1.02e9, "bytes_accessed": 2.0e9})
+    assert ledger.check(rows=[base, near])["ok"]
+
+
+# ------------------------------------------------------------ backfill
+
+
+def test_backfill_fixture_tree(tmp_path):
+    root = tmp_path / "repo"
+    (root / "measurements").mkdir(parents=True)
+    (root / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 1, "tail": "err", "parsed": None}))
+    (root / "BENCH_r02.json").write_text(json.dumps({
+        "n": 2, "cmd": "x", "rc": 0, "tail": "",
+        "parsed": {"metric": "p50 batched merge+weave [smoke size]",
+                   "value": 2.0, "unit": "ms",
+                   "platform": "cpu-fallback"}}))
+    (root / "measurements" / "bench_tpu.log").write_text(
+        "noise line\n"
+        + json.dumps({"metric": "p50 batched merge+weave, 1024 pairs",
+                      "value": 4299.7, "unit": "ms", "kernel": "v5",
+                      "platform": "tpu"}) + "\n"
+        + json.dumps({"metric": "something else", "value": 1}) + "\n")
+    path = str(tmp_path / "ledger.jsonl")
+    added = ledger.backfill(root=str(root), path=path)
+    assert [r["platform"] for r in added] == \
+        ["none", "cpu-fallback", "tpu"]
+    assert added[2]["source"] == "bench_tpu.log"
+    assert added[2]["quarantined"] is False
+    # idempotent: a second backfill adds nothing
+    assert ledger.backfill(root=str(root), path=path) == []
+    verdict = ledger.check(path)
+    assert verdict["ok"] and verdict["rows"] == 3
+
+
+def test_backfill_orders_rounds_numerically(tmp_path):
+    """Append order IS the trajectory: lexicographic glob order would
+    put bench_tpu_r10.log before bench_tpu_r3.log, making the old r3
+    run the partition's 'latest' row — a real regression in r10 would
+    never gate."""
+    root = tmp_path / "repo"
+    (root / "measurements").mkdir(parents=True)
+
+    def _line(v):
+        return json.dumps({
+            "metric": "p50 batched merge+weave, 1024 pairs",
+            "value": v, "unit": "ms", "kernel": "v5",
+            "platform": "tpu"}) + "\n"
+
+    (root / "measurements" / "bench_tpu_r10.log").write_text(
+        _line(9000.0))
+    (root / "measurements" / "bench_tpu_r3.log").write_text(
+        _line(4000.0))
+    path = str(tmp_path / "ledger.jsonl")
+    added = ledger.backfill(root=str(root), path=path)
+    assert [r["source"] for r in added] == \
+        ["bench_tpu_r3.log", "bench_tpu_r10.log"]
+    verdict = ledger.check(path)
+    assert not verdict["ok"]
+    reg = verdict["regressions"][0]
+    assert reg["kind"] == "wall_time"
+    assert reg["source"] == "bench_tpu_r10.log"
+
+
+def test_non_bench_kinds_partition_and_gate_separately(tmp_path):
+    """--kind harvest/soak rows carry no bench-shaped value_ms; with
+    an honest platform tag they must still enter the deterministic
+    -metric gate (not be silently quarantined), in a partition that
+    never mixes with bench rows."""
+    path = str(tmp_path / "ledger.jsonl")
+
+    def _sidecar(name, flops):
+        p = tmp_path / name
+        p.write_text(json.dumps({
+            "ev": "event", "name": "devprof.program", "pid": 1,
+            "fields": {"cost": {"flops": flops,
+                                "bytes_accessed": 10.0}}}) + "\n")
+        return str(p)
+
+    row = ledger.ingest_record(
+        {"platform": "cpu", "kernel": "v5"}, source="harvest-a",
+        obs_jsonl=_sidecar("a.jsonl", 100.0), path=path,
+        kind="harvest")
+    assert row["kind"] == "harvest"
+    assert row["quarantined"] is False
+    # same platform/kernel bench row: different partition, no mixing
+    verdict = ledger.check(rows=ledger.load(path) + [_row("cpu", 5.0)])
+    assert any(lbl.startswith("harvest|cpu|")
+               for lbl in verdict["partitions"])
+    assert verdict["ok"]
+    # a deterministic regression within the harvest partition gates
+    ledger.ingest_record(
+        {"platform": "cpu", "kernel": "v5"}, source="harvest-b",
+        obs_jsonl=_sidecar("b.jsonl", 200.0), path=path,
+        kind="harvest")
+    verdict = ledger.check(path)
+    assert not verdict["ok"]
+    assert verdict["regressions"][0]["kind"] == "devprof"
+    assert verdict["regressions"][0]["partition"].startswith("harvest|")
+    # a fallback-platform harvest row still quarantines
+    fb = ledger.ingest_record(
+        {"platform": "cpu-fallback", "kernel": "v5"}, source="h-fb",
+        path=path, kind="harvest")
+    assert fb["quarantined"] is True
+
+
+def test_backfill_real_tree_trajectory_is_green(tmp_path):
+    """The acceptance gate: the repo's own committed trajectory
+    backfills cleanly and the checker passes it — including the
+    BENCH_r05 fallback row that used to be indistinguishable from a
+    regression."""
+    path = str(tmp_path / "ledger.jsonl")
+    added = ledger.backfill(root=REPO, path=path)
+    platforms = {r["platform"] for r in added}
+    assert "tpu" in platforms            # bench_tpu_r3.log
+    assert "cpu-fallback" in platforms   # BENCH_r02..r05
+    assert all(r["quarantined"] for r in added
+               if r["platform"] == "cpu-fallback")
+    verdict = ledger.check(path)
+    assert verdict["ok"], verdict["regressions"]
+    # partition labels never mix platforms
+    for label in verdict["partitions"]:
+        assert label.split("|")[0] in platforms
+
+
+# ------------------------------------------------------------- ingest
+
+
+def test_ingest_artifact_with_obs_digest(tmp_path):
+    artifact = tmp_path / "bench.json"
+    artifact.write_text(
+        "bench: noise on stderr got tee'd\n"
+        + json.dumps({"metric": "p50 batched merge+weave [smoke size]",
+                      "value": 7.0, "unit": "ms", "platform": "cpu",
+                      "kernel": "v5", "schema_version": 2}) + "\n")
+    sidecar = tmp_path / "obs.jsonl"
+    with open(sidecar, "w") as f:
+        f.write(json.dumps({
+            "ev": "event", "name": "devprof.program", "pid": 1,
+            "fields": {"cost": {"flops": 123.0,
+                                "bytes_accessed": 456.0}}}) + "\n")
+        f.write(json.dumps({
+            "ev": "counters", "pid": 1,
+            "counters": {"program_cache.miss": 1}}) + "\n")
+    path = str(tmp_path / "ledger.jsonl")
+    row = ledger.ingest(str(artifact), source="ci", obs_jsonl=str(sidecar),
+                        path=path)
+    assert row["platform"] == "cpu" and not row["quarantined"]
+    assert row["devprof"]["flops"] == 123.0
+    assert row["devprof"]["programs"] == 1
+    assert row["counters"]["program_cache.miss"] == 1
+    (loaded,) = ledger.load(path)
+    assert loaded["devprof"] == row["devprof"]
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "cause_tpu.obs", "ledger", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+
+
+def test_cli_check_exit_codes(tmp_path):
+    bad = tmp_path / "bad.jsonl"
+    with open(bad, "w") as f:
+        f.write(json.dumps(_row("tpu", 100.0, source="a")) + "\n")
+        f.write(json.dumps(_row("tpu", 300.0, source="b")) + "\n")
+    out = _run_cli("--check", "--ledger", str(bad))
+    assert out.returncode == 1, out.stdout
+    verdict = json.loads(out.stdout)
+    assert verdict["regressions"][0]["kind"] == "wall_time"
+
+    good = tmp_path / "good.jsonl"
+    with open(good, "w") as f:
+        f.write(json.dumps(_row("tpu", 100.0)) + "\n")
+        f.write(json.dumps(_row("tpu", 99.0)) + "\n")
+    assert _run_cli("--check", "--ledger", str(good)).returncode == 0
+
+
+def test_cli_backfill_then_check(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    out = _run_cli("--backfill", "--root", REPO, "--ledger", path)
+    assert out.returncode == 0, out.stderr
+    assert "backfilled" in out.stderr
+    assert _run_cli("--check", "--ledger", path).returncode == 0
+
+
+def test_committed_ledger_exists_and_is_green():
+    """measurements/ledger.jsonl is the artifact of record for
+    trajectory claims (PERF.md); it ships committed and green."""
+    path = os.path.join(REPO, "measurements", "ledger.jsonl")
+    assert os.path.exists(path)
+    rows = ledger.load(path)
+    assert rows, "committed ledger is empty"
+    # the CI smoke baseline partition carries deterministic metrics
+    assert any(r.get("devprof") or r.get("counters") for r in rows)
+    verdict = ledger.check(path)
+    assert verdict["ok"], verdict["regressions"]
+
+
+# ----------------------------------------------------- bench schema v2
+
+
+def test_bench_ledger_append_helper(tmp_path, monkeypatch):
+    """bench.py's obs-on ledger append: artifact line + sidecar in,
+    one quarantine-correct row out (no TPU, no subprocess)."""
+    import bench as bench_mod
+    from cause_tpu import obs
+
+    monkeypatch.setenv("CAUSE_TPU_OBS", "1")
+    obs.reset()
+    try:
+        line = json.dumps({
+            "metric": "p50 batched merge+weave [smoke size]",
+            "value": 3.0, "unit": "ms", "platform": "cpu-fallback",
+            "kernel": "v5",
+            "schema_version": bench_mod.BENCH_SCHEMA_VERSION,
+            "fallback": True})
+        path = str(tmp_path / "ledger.jsonl")
+        bench_mod._append_to_ledger(line, obs_out="",
+                                    ledger_path=path)
+        (row,) = ledger.load(path)
+        assert row["fallback"] is True and row["quarantined"] is True
+        assert row["artifact_schema_version"] == \
+            bench_mod.BENCH_SCHEMA_VERSION
+    finally:
+        monkeypatch.delenv("CAUSE_TPU_OBS", raising=False)
+        obs.reset()
