@@ -636,6 +636,45 @@ def check_obs003(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                     "off the traced path)")
 
 
+_SEMANTIC_APIS = frozenset(
+    {"sync_applied", "sync_full_bag", "observe_wave",
+     "session_overflow", "token_headroom", "gc_compacted",
+     "lazy_materialized", "fleet_report"}
+)
+
+
+@rule("OBS004",
+      "semantic-event/fleet API reached from jit-reachable code "
+      "without an obs.enabled() guard (the CRDT-semantic layer "
+      "assembles real field dicts and walks weaves/version vectors "
+      "the moment obs is on)")
+def check_obs004(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]):
+                # semantic.enabled() IS the sanctioned guard
+                continue
+            is_semantic = (
+                parts[-1] in _SEMANTIC_APIS
+                or any(p in ("semantic", "_semantic", "_sem")
+                       for p in parts[:-1])
+            )
+            if is_semantic and not guarded:
+                yield _finding(
+                    "OBS004", module, call,
+                    f"semantic.{parts[-1]}() on a jit-reachable path "
+                    "without an obs.enabled() guard — unlike the "
+                    "no-op span/counter factories, the semantic layer "
+                    "builds event payloads (staleness bookkeeping, "
+                    "weave scans) when obs is on; gate the call (or "
+                    "hoist it off the traced path)")
+
+
 # ----------------------------------------------------------------- LCA
 
 @rule("LCA001",

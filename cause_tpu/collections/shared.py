@@ -26,6 +26,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from .. import obs
 from .. import pstore
 from .. import util as u
 from ..ids import (
@@ -282,6 +283,12 @@ def ensure_weave(weave_fn: WeaveFn, ct: CausalTree) -> CausalTree:
     object.__setattr__(ct, "weave_tail", None)
     if fresh.lanes is not None:
         object.__setattr__(ct, "lanes", fresh.lanes)
+    if obs.enabled():
+        # semantic layer: each paid materialization records weave
+        # length vs live values + tombstone ratio — the read-side cost
+        # the lazy fleet-editing mode defers, and the quantity GC
+        # exists to reclaim
+        obs.semantic.lazy_materialized(ct)
     return ct
 
 

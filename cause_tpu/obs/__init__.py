@@ -22,6 +22,7 @@ from .core import (
     span,
 )
 from .perfetto import export_perfetto, load_jsonl, to_chrome_trace
+from . import semantic
 
 __all__ = [
     "configure",
@@ -36,6 +37,7 @@ __all__ = [
     "gauge",
     "load_jsonl",
     "reset",
+    "semantic",
     "set_platform",
     "span",
     "to_chrome_trace",

@@ -3,6 +3,7 @@
     python -m cause_tpu.obs events.jsonl -o trace.json   # Perfetto
     python -m cause_tpu.obs stages [--smoke] [--reps N]  # stage ladder
     python -m cause_tpu.obs ledger --check               # perf ledger
+    python -m cause_tpu.obs fleet events.jsonl           # fleet health
 
 The default (first) form converts an obs JSONL event stream to a
 Perfetto trace — open the output at https://ui.perfetto.dev (or
@@ -32,6 +33,10 @@ def main(argv=None) -> int:
         from .ledger import main as ledger_main
 
         return ledger_main(argv[1:])
+    if argv and argv[0] == "fleet":
+        from .fleet import main as fleet_main
+
+        return fleet_main(argv[1:])
     return _convert_main(argv)
 
 

@@ -1,0 +1,208 @@
+"""Fleet health from an obs event stream: ``python -m cause_tpu.obs
+fleet events.jsonl``.
+
+The read side of ``cause_tpu.obs.semantic``: given any obs JSONL (a
+soak sidecar, a CI fleet smoke, a 600k-round overnight run), aggregate
+the CRDT-semantic vocabulary into one operator-facing report —
+
+- **fleet shape** — documents observed, replica pairs (and replicas:
+  a pair is two replicas), waves run;
+- **convergence** — the staleness histogram of the LAST wave per
+  document (how many pairs are 0, 1, 2... waves behind the fleet's
+  modal digest) and every ``divergence`` incident with its
+  first-differing-site provenance;
+- **degradation rates** — delta-sync rounds vs full-bag fallbacks,
+  wave pairs vs host-merge fallbacks vs overflow retries, session
+  token-budget overflows;
+- **GC** — compaction runs, nodes examined/reclaimed, safety-valve
+  declines;
+- **collections** — lazy-weave materializations and the last
+  tombstone ratio.
+
+Counters are merged with the shared per-pid last-snapshot rule
+(``perfetto.merged_final_counters``), so a sidecar shared by a parent
+and an abandoned child reports the sum, not whichever flushed last.
+Stdlib-only, importable without jax, like the rest of ``cause_tpu.obs``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterable, List
+
+from .perfetto import load_jsonl, merged_final_counters
+
+__all__ = ["fleet_report", "render", "main"]
+
+
+def _events_named(events: Iterable[dict], name: str) -> List[dict]:
+    return [e for e in events
+            if e.get("ev") == "event" and e.get("name") == name]
+
+
+def _rate(part: float, whole: float) -> float:
+    return round(part / whole, 4) if whole else 0.0
+
+
+def fleet_report(events: List[dict]) -> dict:
+    """Aggregate one obs event stream into the fleet-health dict the
+    CLI renders (see module docstring for the sections). Total: the
+    report is well-defined on an EMPTY stream — every section zeroes
+    out — because an operator's first question to a broken run is
+    "did anything record at all?"."""
+    waves = _events_named(events, "wave.digest")
+    divergences = _events_named(events, "divergence")
+    counters = merged_final_counters(events)
+
+    # fleet shape + convergence: the LAST wave per DOCUMENT (stream
+    # order, regardless of wave/session source) is its current state —
+    # a doc observed by both merge_wave and a FleetSession is still
+    # ONE doc, and summing per-source histograms would double-count
+    # its pairs and report agreed_documents > documents
+    last_wave: Dict[str, dict] = {}
+    for e in waves:
+        f = e.get("fields") or {}
+        last_wave[str(f.get("uuid"))] = f
+    staleness: Dict[str, int] = {}
+    pairs = 0
+    agreed_now = 0
+    for f in last_wave.values():
+        pairs = max(pairs, int(f.get("pairs") or 0))
+        if f.get("agreed"):
+            agreed_now += 1
+        for bucket, n in (f.get("staleness") or {}).items():
+            staleness[str(bucket)] = staleness.get(str(bucket), 0) + n
+
+    incidents = []
+    for e in divergences:
+        f = e.get("fields") or {}
+        incidents.append({
+            "uuid": f.get("uuid"), "source": f.get("source"),
+            "wave": f.get("wave"), "pair": f.get("pair"),
+            "site": f.get("site"),
+            "site_expected": f.get("site_expected"),
+            "site_got": f.get("site_got"),
+            "disagreeing": f.get("disagreeing"),
+        })
+
+    delta_rounds = counters.get("sync.delta_rounds", 0)
+    full_bag = counters.get("sync.full_bag", 0)
+    wave_pairs = counters.get("wave.pairs", 0)
+    fallback = counters.get("wave.fallback", 0)
+    poisoned = counters.get("wave.poisoned", 0)
+    overflow = counters.get("wave.overflow_retry", 0)
+    examined = counters.get("gc.nodes_examined", 0)
+    reclaimed = counters.get("gc.nodes_reclaimed", 0)
+
+    return {
+        "events": len(events),
+        "documents": len(last_wave),
+        "waves": len(waves),
+        "pairs": pairs,
+        "replicas": 2 * pairs,
+        "agreed_documents": agreed_now,
+        "staleness": dict(sorted(staleness.items(),
+                                 key=lambda kv: int(kv[0]))),
+        "divergence_incidents": incidents,
+        "sync": {
+            "delta_rounds": delta_rounds,
+            "delta_nodes": counters.get("sync.delta_nodes", 0),
+            "full_bag": full_bag,
+            "full_bag_rate": _rate(full_bag, delta_rounds + full_bag),
+        },
+        "wave": {
+            "pairs": wave_pairs,
+            "fallback": fallback,
+            "fallback_rate": _rate(fallback, wave_pairs),
+            "poisoned": poisoned,
+            "overflow_retries": overflow,
+            "session_overflow": counters.get("fleet.session_overflow", 0),
+        },
+        "gc": {
+            "runs": counters.get("gc.runs", 0),
+            "nodes_examined": examined,
+            "nodes_reclaimed": reclaimed,
+            "reclaim_rate": _rate(reclaimed, examined),
+            "safety_valve": counters.get("gc.safety_valve", 0),
+        },
+        "collections": {
+            "lazy_materializations":
+                counters.get("collection.lazy_materialize", 0),
+        },
+    }
+
+
+def render(report: dict) -> str:
+    """The human layout of ``fleet_report`` — one glanceable block."""
+    lines = [
+        f"fleet: {report['replicas']} replicas "
+        f"({report['pairs']} pairs, {report['documents']} document(s)), "
+        f"{report['waves']} wave(s), {report['events']} events",
+        f"  converged now: {report['agreed_documents']}"
+        f"/{report['documents']} document(s)",
+    ]
+    if report["staleness"]:
+        hist = "  ".join(f"{k} wave(s) behind: {v} pair(s)"
+                         for k, v in report["staleness"].items())
+        lines.append(f"  staleness: {hist}")
+    else:
+        lines.append("  staleness: no wave digests recorded")
+    inc = report["divergence_incidents"]
+    lines.append(f"  divergence incidents: {len(inc)}")
+    for d in inc[:10]:
+        lines.append(
+            f"    wave {d['wave']} pair {d['pair']}: first differing "
+            f"site {d['site']!r} (expected {d['site_expected']}, got "
+            f"{d['site_got']}; {d['disagreeing']} pair(s) disagree)")
+    if len(inc) > 10:
+        lines.append(f"    ... {len(inc) - 10} more")
+    s = report["sync"]
+    lines.append(
+        f"  sync: {s['delta_rounds']} delta round(s) "
+        f"({s['delta_nodes']} nodes), {s['full_bag']} full-bag "
+        f"fallback(s) ({100 * s['full_bag_rate']:.1f}%)")
+    w = report["wave"]
+    lines.append(
+        f"  waves: {w['pairs']} pair-merges, {w['fallback']} host "
+        f"fallback(s) ({100 * w['fallback_rate']:.1f}%), "
+        f"{w['poisoned']} poisoned, {w['overflow_retries']} overflow "
+        f"retrie(s), {w['session_overflow']} session overflow(s)")
+    g = report["gc"]
+    lines.append(
+        f"  gc: {g['runs']} run(s), {g['nodes_examined']} examined, "
+        f"{g['nodes_reclaimed']} reclaimed "
+        f"({100 * g['reclaim_rate']:.1f}%), {g['safety_valve']} "
+        f"safety-valve decline(s)")
+    lines.append(
+        f"  collections: "
+        f"{report['collections']['lazy_materializations']} lazy "
+        f"materialization(s)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cause_tpu.obs fleet",
+        description="Render fleet health (replicas, staleness, "
+                    "divergence incidents, overflow/fallback/GC rates) "
+                    "from an obs JSONL event stream.")
+    ap.add_argument("jsonl", help="obs event file (JSON lines)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    a = ap.parse_args(argv)
+    if not os.path.exists(a.jsonl):
+        print(f"fleet: no such file: {a.jsonl}", file=sys.stderr)
+        return 2
+    report = fleet_report(load_jsonl(a.jsonl))
+    if a.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

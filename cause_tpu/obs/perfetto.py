@@ -8,6 +8,13 @@ chrome://tracing) open directly:
   per-process/per-thread track, with the span attributes AND the
   ``TRACE_SWITCHES`` program-identity snapshot as args;
 - ``event`` records become instant events ("ph": "i", thread scope);
+  events in the CRDT-semantic vocabulary (``semantic.
+  SEMANTIC_EVENT_PREFIXES`` — ``sync.*``, ``wave.digest``,
+  ``divergence``, ``gc.*``, ``collection.*``, ``fleet.*``) are routed
+  onto their own NAMED instant-event track per family (a synthetic
+  tid with ``thread_name`` metadata), so fleet health reads as
+  labelled swim-lanes above the span tracks instead of dots buried in
+  whichever thread happened to emit them;
 - ``counters`` snapshots become one counter track per metric
   ("ph": "C"), so program-cache hit/miss rates and fallback counts
   plot as time series next to the spans they explain;
@@ -25,6 +32,22 @@ from typing import Iterable, List, Optional
 
 __all__ = ["to_chrome_trace", "export_perfetto", "load_jsonl",
            "merged_final_counters"]
+
+# synthetic-tid base for the named semantic tracks: far above any real
+# OS thread id's low bits mattering for display, stable across runs so
+# diffs of exported traces stay comparable
+_SEMANTIC_TID_BASE = 0x5EA00000
+
+
+def _semantic_family(name: str) -> Optional[str]:
+    """The semantic track family of an instant event's name, or None
+    for ordinary (thread-track) events."""
+    from .semantic import SEMANTIC_EVENT_PREFIXES
+
+    for prefix in SEMANTIC_EVENT_PREFIXES:
+        if name == prefix or name.startswith(prefix):
+            return prefix.rstrip(".")
+    return None
 
 
 def load_jsonl(path: str) -> List[dict]:
@@ -83,6 +106,10 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
     """The Chrome Trace Event envelope for an obs event stream."""
     trace: List[dict] = []
     pids = set()
+    # (pid, family) -> synthetic tid for the named semantic tracks;
+    # allocation order is first-seen, names come from thread_name
+    # metadata emitted at the end
+    semantic_tids: dict = {}
     for e in events:
         ev = e.get("ev")
         pid = e.get("pid", 0)
@@ -102,14 +129,22 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
             args = dict(e.get("fields") or {})
             if e.get("platform"):
                 args.setdefault("platform", e["platform"])
+            name = e.get("name", "?")
+            family = _semantic_family(name)
+            if family is not None:
+                tid = semantic_tids.setdefault(
+                    (pid, family),
+                    _SEMANTIC_TID_BASE + len(semantic_tids))
+            else:
+                tid = e.get("tid", 0)
             trace.append({
-                "name": e.get("name", "?"),
-                "cat": "obs",
+                "name": name,
+                "cat": "obs.semantic" if family is not None else "obs",
                 "ph": "i",
                 "s": "t",
                 "ts": e.get("ts_us", 0),
                 "pid": pid,
-                "tid": e.get("tid", 0),
+                "tid": tid,
                 "args": args,
             })
         elif ev == "gauge":
@@ -140,6 +175,15 @@ def to_chrome_trace(events: Iterable[dict]) -> dict:
             "ph": "M",
             "pid": pid,
             "args": {"name": f"cause_tpu pid {pid}"},
+        })
+    for (pid, family), tid in sorted(semantic_tids.items(),
+                                     key=lambda kv: kv[1]):
+        trace.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": f"semantic:{family}"},
         })
     return {"traceEvents": trace, "displayTimeUnit": "ms"}
 

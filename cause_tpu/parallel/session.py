@@ -42,7 +42,7 @@ from ..weaver import lanecache
 from ..weaver.arrays import next_pow2
 from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
 from .wave import (WaveBuffers, _PAD, _assemble_rows, _digest_fn,
-                   _sampled_body_spotcheck)
+                   _observe_semantics, _sampled_body_spotcheck)
 
 __all__ = ["FleetSession"]
 
@@ -166,6 +166,12 @@ class FleetSession:
         self.u_max = max(self.u_max, next_pow2(
             int(u * self._u_headroom) + self.d_max
         ))
+        if obs.enabled():
+            # resident-budget headroom: how far the CURRENT fleet sits
+            # below the session's compiled token ceiling
+            from ..obs import semantic as _sem
+
+            _sem.token_headroom(int(self.u_max) - int(u), "session")
         self.capacity = cap
         self.dev = {k: jnp.asarray(v) for k, v in lanes.items()}
         self._views = views
@@ -339,12 +345,24 @@ class FleetSession:
 
             devprof.sample_device_memory("session")
         if bool(np.asarray(ov).any()):
+            rows = np.flatnonzero(np.asarray(ov)).tolist()
+            if obs.enabled():
+                # an overflowed wave's digests are garbage — record
+                # the incident, never feed them to the monitors
+                from ..obs import semantic as _sem
+
+                _sem.session_overflow(rows)
             raise s.CausalError(
                 "wave overflowed the session's token budget; raise "
                 "u_headroom or re-create the session",
-                {"causes": {"token-overflow"},
-                 "rows": np.flatnonzero(np.asarray(ov)).tolist()},
+                {"causes": {"token-overflow"}, "rows": rows},
             )
+        if obs.enabled():
+            # every session digest is device-computed (overflow raised
+            # above), so the whole wave feeds the divergence monitors
+            _observe_semantics(self.pairs, out,
+                               np.ones(len(self.pairs), bool),
+                               "session")
         return out
 
     def merged(self, i: int):

@@ -96,6 +96,33 @@ _PAD = {
     "seg": -1,
 }
 
+
+def _observe_semantics(pairs, digests, valid, source: str) -> None:
+    """One wave's CRDT-semantic telemetry (``wave.digest`` agreement,
+    per-pair staleness, ``divergence`` provenance) — obs-on callers
+    only. The version-vector callback is lazy: vectors are built from
+    the yarn caches only when a divergence actually needs
+    first-differing-site provenance, never on the agreeing fast
+    path."""
+    from ..obs import semantic
+    from ..sync import version_vector
+
+    if not semantic.enabled():
+        return
+
+    def vv_of(i):
+        # the merged pair's vector: pointwise max of both replicas'
+        a, b = pairs[i]
+        vv = {k: list(v) for k, v in version_vector(a).items()}
+        for site, h in version_vector(b).items():
+            h = list(h)
+            if site not in vv or vv[site] < h:
+                vv[site] = h
+        return vv
+
+    semantic.observe_wave(pairs[0][0].ct.uuid, digests, valid,
+                          vv_of=vv_of, source=source)
+
 # Lanes sampled per tree per wave by the body spot-check below.
 # CAUSE_TPU_BODY_SAMPLE=0 disables; a value >= the tree size checks
 # every lane (what the adversarial tests use).
@@ -393,6 +420,11 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
         obs.counter("wave.pairs").inc(B)
         obs.counter("wave.fallback").inc(len(fallback))
         obs.counter("wave.poisoned").inc(len(poisoned))
+        if obs.enabled():
+            # the wave still happened; every pair ages (no device
+            # digest converged it against the fleet's modal value)
+            _observe_semantics(pairs, np.zeros(B, np.uint32),
+                               np.zeros(B, bool), "wave")
         return WaveResult(pairs, views, 0,
                           np.zeros((B, 0), np.int32),
                           np.zeros((B, 0), bool),
@@ -455,7 +487,15 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
     # pow2-quantized budget: every distinct u_max is a distinct XLA
     # program, so exact budgets would recompile on every wave whose
     # divergence shifted slightly
-    u_max = next_pow2(v5_token_budget(lanes))
+    u_need = int(v5_token_budget(lanes))
+    u_max = next_pow2(u_need)
+    if obs.enabled():
+        # token-budget headroom: the pow2 slack this fleet has before
+        # a divergence spike overflows the kernel and forces
+        # retries/host fallbacks
+        from ..obs import semantic as _sem
+
+        _sem.token_headroom(int(u_max) - u_need, "wave")
     with obs.span("wave.dispatch", kernel=pipeline,
                   rows=len(live_views), u_max=int(u_max),
                   sharded=mesh is not None):
@@ -524,6 +564,9 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
     obs.counter("wave.fallback").inc(len(fallback))
     obs.counter("wave.poisoned").inc(len(poisoned))
     if obs.enabled():
+        # semantic layer: digest agreement, staleness aging, and (on
+        # disagreement) one divergence event with site provenance
+        _observe_semantics(pairs, full_dig, dig_valid, "wave")
         # devprof wave-boundary sample: live device arrays + backend
         # memory after the dispatch settle, so per-wave residency
         # renders as a curve next to the dispatch spans

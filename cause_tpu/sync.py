@@ -35,8 +35,10 @@ import struct
 import threading
 from typing import Dict, Optional, Tuple
 
+from . import obs
 from .collections import shared as s
 from . import serde
+from .obs import semantic as _sem
 
 __all__ = [
     "version_vector",
@@ -101,10 +103,13 @@ def shadow(handle, nodes: dict):
     return type(handle)(handle.ct.evolve(nodes=dict(nodes)))
 
 
-def apply_delta(handle, nodes: dict):
+def apply_delta(handle, nodes: dict, _count_as_delta: bool = True):
     """Merge a received delta into ``handle`` (no-op for an empty
     delta). Raises CausalError exactly like a local merge would on
     append-only conflicts, uuid mismatch, or missing causes.
+    ``_count_as_delta=False`` is the full-bag call sites' spelling:
+    a resend of the whole bag must not count as a delta round in the
+    semantic degradation rate.
 
     Path choice matters on the default pure weaver: ``merge`` replays
     the delta incrementally (O(delta x doc) — right for anti-entropy's
@@ -116,10 +121,18 @@ def apply_delta(handle, nodes: dict):
     if not nodes:
         return handle
     sh = shadow(handle, nodes)
-    if (handle.ct.weaver == "pure"
-            and len(nodes) * 8 < len(handle.ct.nodes)):
-        return handle.merge(sh)
-    return handle.merge_many([sh])
+    incremental = (handle.ct.weaver == "pure"
+                   and len(nodes) * 8 < len(handle.ct.nodes))
+    merged = handle.merge(sh) if incremental else handle.merge_many([sh])
+    # emitted only AFTER the merge validated: a rejected delta is a
+    # full-bag round, not a delta round — recording it before the
+    # raise would make every degraded round count twice and understate
+    # the full_bag_rate the fleet CLI reports
+    if _count_as_delta and obs.enabled():
+        _sem.sync_applied(len(nodes),
+                          "incremental" if incremental else "union",
+                          uuid=handle.ct.uuid)
+    return merged
 
 
 def send_frame(stream, obj: dict) -> None:
@@ -278,10 +291,15 @@ def sync_stream(handle, stream):
             {"causes": {"bad-frame"}, "expected": "done|resync"},
         )
     if peer_state.get("op") == "resync" or not ok:
+        if obs.enabled():
+            _sem.sync_full_bag(
+                "cause-must-exist" if not ok else "peer-resync",
+                uuid=ct.uuid)
         full = exchange_frame(stream, {
             "op": "full", "nodes": serde.encode_node_items(dict(ct.nodes)),
         })
-        merged = apply_delta(merged, decode_frame_nodes(full, "full"))
+        merged = apply_delta(merged, decode_frame_nodes(full, "full"),
+                             _count_as_delta=False)
     return merged
 
 
@@ -298,7 +316,10 @@ def sync_pair(a, b) -> Tuple[object, object]:
             if "cause-must-exist" not in e.info.get("causes", ()):
                 raise
             # non-prefix history (weft, gapped replica): full bag
-            return apply_delta(dst, dict(src.ct.nodes))
+            if obs.enabled():
+                _sem.sync_full_bag("cause-must-exist", uuid=dst.ct.uuid)
+            return apply_delta(dst, dict(src.ct.nodes),
+                               _count_as_delta=False)
 
     return one_way(a, b, va), one_way(b, a, vb)
 

@@ -1,55 +1,4 @@
 #!/bin/bash
-# Outer retry loop for the round-3 TPU measurement queue. Waits for
-# scripts/run_queue.sh (single pass) to finish, then keeps re-running
-# items whose logs show no success until they do (or 24 h passes).
-# One axon claimant at a time; nothing is ever killed.
-set -u
-cd "$(dirname "$0")/.."
-mkdir -p measurements
-
-while pgrep -f "run_queue.sh" > /dev/null 2>&1; do sleep 60; done
-
-ok() {  # item succeeded? bench items need a tpu-tagged JSON line;
-        # everything else needs rc=0 recorded by a completed attempt
-        # (partial logs from a crashed run must NOT count)
-  case "$1" in
-    bench_*) grep -q '"platform": "tpu"' "measurements/$1.log" 2>/dev/null ;;
-    probe_v5_stages_tpu_r3) grep -q "prefix->FULL" "measurements/$1.log" 2>/dev/null ;;
-    *) [ "$(cat "measurements/$1.rc" 2>/dev/null)" = "0" ] ;;
-  esac
-}
-
-declare -A CMDS=(
-  [probe_v5_stages_tpu_r3]="python -u scripts/probe_v5_stages.py"
-  [probe_v5_stages_allstream_tpu_r3]="python -u scripts/probe_v5_stages.py --allstream"
-  [bench_v5w_tpu_r3]="env BENCH_KERNEL=v5w BENCH_NO_ALLSTREAM=1 BENCH_TIMEOUT=2400 python bench.py"
-  [bench_v5_bitonic_tpu_r3]="env CAUSE_TPU_SORT=bitonic BENCH_TIMEOUT=2400 python bench.py"
-  [bench_v5_rowgather_tpu_r3]="env CAUSE_TPU_GATHER=rowgather BENCH_TIMEOUT=2400 python bench.py"
-  [bench_v5_allstream_tpu_r3]="env CAUSE_TPU_GATHER=rowgather CAUSE_TPU_SORT=bitonic CAUSE_TPU_SEARCH=matrix BENCH_TIMEOUT=2400 python bench.py"
-  [probe_v4_tpu_r3]="python -u scripts/probe_v4.py"
-  [pallas_probe_tpu_r3]="python -u scripts/pallas_probe.py"
-  [fleet_bench_tpu_r3]="python -u scripts/fleet_bench.py"
-  [microbench_tpu_r3]="python -u scripts/tpu_microbench.py"
-)
-ORDER="bench_v5_allstream_tpu_r3 probe_v5_stages_tpu_r3 \
-probe_v5_stages_allstream_tpu_r3 \
-microbench_tpu_r3 bench_v5_rowgather_tpu_r3 bench_v5_bitonic_tpu_r3 \
-bench_v5w_tpu_r3 probe_v4_tpu_r3 pallas_probe_tpu_r3 \
-fleet_bench_tpu_r3"
-
-deadline=$(( $(date +%s) + 86400 ))
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  all_ok=1
-  for name in $ORDER; do
-    if ok "$name"; then continue; fi
-    all_ok=0
-    echo "watcher: [$(date -u +%H:%M:%S)] retry $name" >&2
-    ${CMDS[$name]} > "measurements/${name}.log" 2>&1
-    rc=$?
-    echo "$rc" > "measurements/${name}.rc"
-    echo "watcher: [$(date -u +%H:%M:%S)] $name rc=$rc ok=$(ok "$name" && echo y || echo n)" >&2
-  done
-  [ "$all_ok" = 1 ] && break
-  sleep 180
-done
-echo "watcher: done" >&2
+# Delegator kept for PERF.md command compatibility: generation 1 of the
+# round-3 queue watcher, now one parameterization of tunnel_watcher.sh.
+exec bash "$(dirname "$0")/tunnel_watcher.sh" queue --hours 24

@@ -322,6 +322,43 @@ ROUNDS = (list_round, wave_round, map_round, base_round, gc_round,
           v5f_round)
 
 
+def _append_soak_ledger_row(args, done: int, seed: int) -> None:
+    """The run-of-record row: a completed soak lands in the persistent
+    perf ledger (``--kind soak`` — deterministic counters gate, wall
+    time never does) with its sidecar's counter digest, so the next
+    600k-round trajectory is a machine-gated artifact like a bench
+    run, not a log line in PERF.md. Best-effort and obs-on only: a
+    ledger failure must never fail a clean soak."""
+    from cause_tpu import obs
+    from cause_tpu.obs import ledger
+
+    if not (args.obs_out and obs.enabled()):
+        return
+    try:
+        row = ledger.ingest_record(
+            {
+                "platform": jax.default_backend(),
+                "metric": "soak rounds clean",
+                "value": None,
+                "kernel": "soak",
+                # duration partitions the trajectory: a 60-minute
+                # soak's counter totals only gate against other
+                # 60-minute soaks
+                "config": f"minutes={args.minutes:g}",
+                "smoke": False,
+            },
+            source=f"soak seed0={args.seed0} rounds={done} "
+                   f"last_seed={seed}",
+            obs_jsonl=args.obs_out,
+            kind="soak",
+        )
+        print(f"soak: ledger row ({row['platform']}) -> "
+              f"{ledger.default_path()}", flush=True)
+    except Exception as e:  # noqa: BLE001 - best-effort ledger append
+        print(f"soak: ledger append skipped ({type(e).__name__}: {e})",
+              flush=True)
+
+
 def main():
     from cause_tpu import obs
 
@@ -329,11 +366,16 @@ def main():
     ap.add_argument("--minutes", type=float, default=60.0)
     ap.add_argument("--seed0", type=int, default=0)
     ap.add_argument("--obs-out", default="",
-                    help="stream structured obs events (JSONL) to "
-                         "this path instead of raw prints only")
+                    help="stream structured obs events (spans AND the "
+                         "CRDT-semantic fleet vocabulary, JSONL) to "
+                         "this path instead of raw prints only; a "
+                         "clean run also appends a --kind soak row to "
+                         "the perf ledger")
     args = ap.parse_args()
     if args.obs_out:
         obs.configure(enabled=True, out=args.obs_out)
+        # honest platform tags on every record (obs never asks jax)
+        obs.set_platform(jax.default_backend())
     deadline = time.monotonic() + args.minutes * 60
     seed = args.seed0
     done = 0
@@ -355,7 +397,10 @@ def main():
         obs.counter("soak.rounds").inc()
         if done % 25 == 0:
             print(f"soak: {done} rounds clean (seed {seed})", flush=True)
+    obs.event("soak.done", rounds=done, seed0=args.seed0,
+              last_seed=seed)
     obs.flush()
+    _append_soak_ledger_row(args, done, seed)
     print(f"soak finished: {done} rounds clean, no failures", flush=True)
 
 

@@ -1,113 +1,6 @@
 #!/bin/bash
-# Round-4 TPU window watcher: keep exactly ONE axon claimant queued
-# against the tunnel at all times, so the instant a window opens the
-# harvester (scripts/harvest.py — the whole measurement ladder in one
-# claim) starts measuring. Never kills a client (round-2 lesson: a
-# killed axon client mid-compile can wedge the tunnel server); each
-# attempt is waited for to natural exit, and every launched script
-# self-bounds its backend-claim wait via HARVEST_CLAIM_DEADLINE
-# (scripts/claimguard.py) so a wedged claim cannot outlive the
-# watcher's deadline. Deadline-capped so the tunnel is clear before
-# the driver's round-end bench.
-#
-# Phase gates require BOTH rc=0 and a chip-tagged log (round-3 ok()
-# discipline: partial logs from a crashed run must not count), recorded
-# as .ok marker files. Logs are append-only: a retry must never
-# truncate a prior attempt's partial on-chip evidence.
-#
+# Delegator kept for PERF.md command compatibility: the round-4 TPU
+# window watcher (fixed predicted-winner wave env, 30 s pacing), now
+# one parameterization of tunnel_watcher.sh.
 # Usage: nohup bash scripts/watcher_r4.sh [deadline-hours] &
-set -u
-cd "$(dirname "$0")/.."
-mkdir -p measurements
-HOURS="${1:-10}"
-WLOG=measurements/watcher_r4.log
-note() { echo "watcher: [$(date -u +%F' '%H:%M:%S)] $*" >> "$WLOG"; }
-
-# The deadline is anchored at LAUNCH, before any lock wait: a stalled
-# predecessor must eat into this instance's window, not extend it past
-# the round-end bench the cap exists to protect.
-deadline=$(( $(date +%s) + HOURS * 3600 ))
-
-# single-instance lock: two watchers = two axon claimants starving
-# each other on the relay. Bounded BLOCKING acquire: a replaced
-# watcher's measurement child inherits the lock fd and holds it until
-# it exits, so the successor waits (children here are launched with
-# 9>&- so they stop inheriting it going forward); if the lock is still
-# held at this instance's own deadline, give up rather than queue a
-# surprise extra window.
-exec 9> measurements/.watcher_r4.lock
-note "waiting for the instance lock"
-if ! flock -w $(( deadline - $(date +%s) )) 9; then
-  note "lock still held at deadline; exiting without measuring"
-  exit 1
-fi
-# wait out any still-running measurement claimants (round-3 queue
-# leftovers, or an orphaned child from a replaced watcher — any phase)
-while pgrep -f "run_queue.sh|queue_watcher|scripts/harvest.py|scripts/api_bench.py|[ /]bench.py" \
-    > /dev/null 2>&1; do
-  [ "$(date +%s)" -ge "$deadline" ] && { note "deadline during claimant wait; exiting"; exit 1; }
-  note "waiting for existing claimant processes to exit"
-  sleep 60
-done
-# bound each attempt's backend-claim wait by the remaining watcher time
-# (floor 300s, cap 3300s)
-claim_remain() {
-  local r=$(( deadline - $(date +%s) ))
-  [ "$r" -lt 300 ] && r=300
-  [ "$r" -gt 3300 ] && r=3300
-  echo "$r"
-}
-
-note "armed; deadline in ${HOURS}h"
-i=0
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  i=$((i+1))
-  # Phase 1: the kernel ladder harvest (self-skips completed items)
-  if [ ! -e measurements/harvest_tpu_r4.ok ]; then
-    note "attempt $i: harvest"
-    HARVEST_CLAIM_DEADLINE=$(claim_remain) \
-      python -u scripts/harvest.py >> measurements/harvest_tpu_r4.log \
-      2>> measurements/harvest_tpu_r4.err 9>&-
-    rc=$?
-    note "attempt $i: harvest rc=$rc"
-    if [ "$rc" = 0 ] && grep -qs '"ev": "done", "complete": true' \
-        measurements/harvest_tpu_r4.log; then
-      touch measurements/harvest_tpu_r4.ok
-    fi
-  # Phase 2: end-to-end API wave + FleetSession on the chip, under
-  # the predicted-winner kernel config (bit-identical by the combined
-  # parity suite; worst case a slower but still-valid chip number)
-  elif [ ! -e measurements/api_wave_tpu_r4.ok ]; then
-    note "attempt $i: api_bench wave (beststream config)"
-    HARVEST_CLAIM_DEADLINE=$(claim_remain) \
-      CAUSE_TPU_SORT=pallas CAUSE_TPU_GATHER=rowgather \
-      CAUSE_TPU_SEARCH=matrix-table CAUSE_TPU_SCATTER=hint \
-      python -u scripts/api_bench.py --wave 1024 \
-      >> measurements/api_wave_tpu_r4.log \
-      2>> measurements/api_wave_tpu_r4.err 9>&-
-    rc=$?
-    note "attempt $i: api_bench rc=$rc"
-    if [ "$rc" = 0 ] && grep -qs '"platform": "tpu' \
-        measurements/api_wave_tpu_r4.log; then
-      touch measurements/api_wave_tpu_r4.ok
-    fi
-  # Phase 3: bookend bench.py (driver-format artifact, repetition).
-  # BENCH_TAG is cleared so the chip gate greps the real platform.
-  elif [ ! -e measurements/bench_tpu_r4.ok ]; then
-    note "attempt $i: bench.py bookend"
-    env -u BENCH_TAG BENCH_PROBE_TIMEOUT=$(claim_remain) \
-      python bench.py >> measurements/bench_tpu_r4.log \
-      2>> measurements/bench_tpu_r4.err 9>&-
-    rc=$?
-    note "attempt $i: bench rc=$rc"
-    if [ "$rc" = 0 ] && grep -qs '"platform": "tpu' \
-        measurements/bench_tpu_r4.log; then
-      touch measurements/bench_tpu_r4.ok
-    fi
-  else
-    note "all phases chip-tagged; exiting"
-    break
-  fi
-  sleep 30
-done
-note "done"
+exec bash "$(dirname "$0")/tunnel_watcher.sh" harvest --round r4 --hours "${1:-10}"

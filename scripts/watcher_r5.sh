@@ -1,143 +1,8 @@
 #!/bin/bash
-# Round-5 TPU window watcher: keep exactly ONE axon claimant queued
-# against the tunnel at all times, so the instant a window opens the
-# harvester (scripts/harvest.py — the whole measurement ladder in one
-# claim) starts measuring. Never kills a client (round-2 lesson: a
-# killed axon client mid-compile can wedge the tunnel server); each
-# attempt is waited for to natural exit, and every launched script
-# self-bounds its backend-claim wait via HARVEST_CLAIM_DEADLINE
-# (scripts/claimguard.py) so a wedged claim cannot outlive the
-# watcher's deadline. Deadline-capped so the tunnel is clear before
-# the driver's round-end bench.
-#
-# Round-5 note (ADVICE.md #4): after a claimguard rc=3 hard-exit, the
-# pre-compile-exit-is-safe assumption is unverified on hardware — back
-# off longer (300s instead of 30s) before the next attempt so a
-# potentially irritated relay gets slack, and log it distinctly.
-#
-# Phase gates require BOTH rc=0 and a chip-tagged log (round-3 ok()
-# discipline: partial logs from a crashed run must not count), recorded
-# as .ok marker files. Logs are append-only: a retry must never
-# truncate a prior attempt's partial on-chip evidence.
-#
+# Delegator kept for PERF.md command compatibility: the round-5 TPU
+# window watcher (digest-certified beststream env, straight-through
+# phase resume, 300 s claimguard-rc3 back-off — ADVICE r5 #4), now one
+# parameterization of tunnel_watcher.sh.
 # Usage: nohup bash scripts/watcher_r5.sh [deadline-hours] &
-set -u
-cd "$(dirname "$0")/.."
-mkdir -p measurements
-HOURS="${1:-10}"
-WLOG=measurements/watcher_r5.log
-note() { echo "watcher: [$(date -u +%F' '%H:%M:%S)] $*" >> "$WLOG"; }
-
-# The deadline is anchored at LAUNCH, before any lock wait: a stalled
-# predecessor must eat into this instance's window, not extend it past
-# the round-end bench the cap exists to protect.
-deadline=$(( $(date +%s) + HOURS * 3600 ))
-
-# single-instance lock: two watchers = two axon claimants starving
-# each other on the relay. Bounded BLOCKING acquire (see watcher_r4).
-exec 9> measurements/.watcher_r5.lock
-note "waiting for the instance lock"
-if ! flock -w $(( deadline - $(date +%s) )) 9; then
-  note "lock still held at deadline; exiting without measuring"
-  exit 1
-fi
-# wait out any still-running measurement claimants (driver bench runs,
-# round-4 leftovers, or an orphaned child from a replaced watcher)
-while pgrep -f "run_queue.sh|queue_watcher|watcher_r4|scripts/harvest.py|scripts/api_bench.py|[ /]bench.py" \
-    > /dev/null 2>&1; do
-  [ "$(date +%s)" -ge "$deadline" ] && { note "deadline during claimant wait; exiting"; exit 1; }
-  note "waiting for existing claimant processes to exit"
-  sleep 60
-done
-# bound each attempt's backend-claim wait by the remaining watcher time
-# (floor 300s, cap 3300s)
-claim_remain() {
-  local r=$(( deadline - $(date +%s) ))
-  [ "$r" -lt 300 ] && r=300
-  [ "$r" -gt 3300 ] && r=3300
-  echo "$r"
-}
-
-note "armed; deadline in ${HOURS}h"
-i=0
-while [ "$(date +%s)" -lt "$deadline" ]; do
-  i=$((i+1))
-  # Phase 1: the kernel ladder harvest (self-skips completed items)
-  if [ ! -e measurements/harvest_tpu_r5.ok ]; then
-    note "attempt $i: harvest"
-    HARVEST_CLAIM_DEADLINE=$(claim_remain) \
-      python -u scripts/harvest.py >> measurements/harvest_tpu_r5.log \
-      2>> measurements/harvest_tpu_r5.err 9>&-
-    rc=$?
-    note "attempt $i: harvest rc=$rc"
-    if [ "$rc" = 0 ] && grep -qs '"ev": "done", "complete": true' \
-        measurements/harvest_tpu_r5.log; then
-      touch measurements/harvest_tpu_r5.ok
-    fi
-  # Phase 2: end-to-end API wave + FleetSession on the chip, under
-  # the predicted-winner kernel config (bit-identical by the combined
-  # parity suite; worst case a slower but still-valid chip number)
-  elif [ ! -e measurements/api_wave_tpu_r5.ok ]; then
-    # beststream config only once the digest gate CERTIFIED it (the
-    # state file records verify_beststream on MATCH; a stale suspects
-    # log line from an earlier window must not demote a later-fixed
-    # config, and an uncertified config must not produce the round's
-    # wave number). Env derives from harvest.BESTSTREAM — restating
-    # it here is the drift trap switches.py warns about.
-    if grep -qs '"verify_beststream"' measurements/harvest_state_r5.json 2>/dev/null; then
-      BS_ENV=$(PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu python -c "
-import sys; sys.path.insert(0, 'scripts'); import harvest
-print(harvest.certified_env())")
-      # the fused pipeline rides the wave too, once ITS gate certified
-      if grep -qs '"verify_v5f"' measurements/harvest_state_r5.json 2>/dev/null; then
-        BS_ENV="$BS_ENV BENCH_KERNEL=v5f"
-      fi
-      note "attempt $i: api_bench wave (certified beststream: $BS_ENV)"
-      HARVEST_CLAIM_DEADLINE=$(claim_remain) \
-        env $BS_ENV python -u scripts/api_bench.py --wave 1024 \
-        >> measurements/api_wave_tpu_r5.log \
-        2>> measurements/api_wave_tpu_r5.err 9>&-
-    else
-      note "attempt $i: api_bench wave (shipped default; beststream not digest-certified)"
-      HARVEST_CLAIM_DEADLINE=$(claim_remain) \
-        python -u scripts/api_bench.py --wave 1024 \
-        >> measurements/api_wave_tpu_r5.log \
-        2>> measurements/api_wave_tpu_r5.err 9>&-
-    fi
-    rc=$?
-    note "attempt $i: api_bench rc=$rc"
-    if [ "$rc" = 0 ] && grep -qs '"platform": "tpu' \
-        measurements/api_wave_tpu_r5.log; then
-      touch measurements/api_wave_tpu_r5.ok
-    fi
-  # Phase 3: bookend bench.py (driver-format artifact, repetition).
-  # BENCH_TAG is cleared so the chip gate greps the real platform.
-  elif [ ! -e measurements/bench_tpu_r5.ok ]; then
-    note "attempt $i: bench.py bookend"
-    env -u BENCH_TAG BENCH_PROBE_TIMEOUT=$(claim_remain) \
-      python bench.py >> measurements/bench_tpu_r5.log \
-      2>> measurements/bench_tpu_r5.err 9>&-
-    rc=$?
-    note "attempt $i: bench rc=$rc"
-    if [ "$rc" = 0 ] && grep -qs '"platform": "tpu' \
-        measurements/bench_tpu_r5.log; then
-      touch measurements/bench_tpu_r5.ok
-    fi
-  else
-    note "all phases chip-tagged; exiting"
-    break
-  fi
-  # Success (phase just chip-tagged): continue straight into the next
-  # phase — windows are ~6 min and a sleep here burns open-window time.
-  # ADVICE #4: after a claimguard rc=3 hard-exit the
-  # pre-compile-exit-is-safe assumption is unverified — back off 300s.
-  if [ "${rc:-1}" = 0 ]; then
-    :
-  elif [ "${rc:-0}" = 3 ]; then
-    note "rc=3 (claimguard pre-compile exit); backing off 300s"
-    sleep 300
-  else
-    sleep 30
-  fi
-done
-note "done"
+exec bash "$(dirname "$0")/tunnel_watcher.sh" harvest --round r5 \
+  --certified --fast-resume --rc3-backoff 300 --hours "${1:-10}"

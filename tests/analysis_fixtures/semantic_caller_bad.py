@@ -1,0 +1,47 @@
+"""Known-bad OBS004 fixture: CRDT-semantic APIs on a traced path.
+Only the unguarded calls gate — every OBS003 guard spelling (nested
+if, semantic.enabled, aliased import, early return, negated-test
+else) is sanctioned here too."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu.obs import semantic
+from cause_tpu.obs import semantic as _sem
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    semantic.observe_wave("u", [1], [True])       # OBS004: unguarded
+    if obs.enabled():
+        semantic.observe_wave("u", [1], [True])   # guarded: fine
+    if semantic.enabled():
+        # the module's own guard spelling must not be flagged as an
+        # unguarded semantic call itself
+        semantic.sync_full_bag("peer-resync")
+    if _obs_enabled():
+        # the aliased guard + aliased module spellings are fine
+        _sem.gc_compacted(10, 2)
+    return x * 2
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    semantic.token_headroom(8, "wave")
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful semantic call), its ELSE branch is
+    # obs-on only (guarded: fine)
+    if not obs.enabled():
+        semantic.sync_applied(3, "union")         # OBS004
+    else:
+        semantic.sync_applied(3, "union")         # guarded: fine
+    return x
