@@ -142,6 +142,26 @@ def _append_to_ledger(artifact_line: str, obs_out: str,
         print(f"bench: ledger append failed ({e})", file=sys.stderr)
 
 
+def _print_gap_report(obs_out: str) -> None:
+    """With obs on, every bench run ends with the north-star gap
+    decomposition (committed ledger + this run's sidecar) on stderr —
+    the prose in PERF.md narrates this artifact; the CLI computes it.
+    Best-effort: a gap failure must never cost the bench artifact."""
+    if not obs.enabled():
+        return
+    try:
+        from cause_tpu.obs import load_jsonl
+        from cause_tpu.obs.costmodel import gap_report, render_gap
+        from cause_tpu.obs.ledger import load as ledger_load
+
+        events = load_jsonl(obs_out) if (
+            obs_out and os.path.exists(obs_out)) else []
+        print(render_gap(gap_report(ledger_load(), events)),
+              file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - best-effort report
+        print(f"bench: gap report failed ({e})", file=sys.stderr)
+
+
 def _export_obs_trace(obs_out: str) -> None:
     """Convert the run's obs sidecar (parent + children appends) into
     a Perfetto-openable trace next to it. Best-effort: a trace export
@@ -299,11 +319,28 @@ def measure(platform: str) -> dict:
     last_ck = [None]
 
     def step(k: int, kernel: str) -> None:
+        if obs.enabled():
+            # one timed step is one wave: its wave.cost event carries
+            # the synthetic batch's KNOWN divergence (2*n_div suffix
+            # ops per pair) next to the dispatch count and wall span,
+            # so bench sidecars feed the cost-vs-divergence join too
+            from cause_tpu.obs import costmodel as _cm
+
+            _cm.wave_begin("bench")
         # one transfer fetches checksum + overflow and forces execution
         out = np.asarray(dispatch(k, kernel))
         if k and out[1]:  # overflowed rows carry garbage ranks
             raise _Overflow()
         last_ck[0] = float(out[0])
+        if obs.enabled():
+            from cause_tpu.obs import costmodel as _cm
+
+            v5_family = kernel in ("v5", "v5w", "v5f")
+            _cm.wave_cost(
+                uuid="bench", pairs=B, lanes=2 * cap * B,
+                tokens=k * B if v5_family else None,
+                token_budget=k * B if v5_family else 0,
+                delta_ops=2 * n_div * B)
 
     N_BURST = int(os.environ.get("BENCH_BURST", "8"))
 
@@ -664,6 +701,7 @@ def main() -> None:
             print(line)
             _export_obs_trace(obs_out)
             _append_to_ledger(line, obs_out)
+            _print_gap_report(obs_out)
             return
         tail = (err or "").strip().splitlines()[-1:] or ["?"]
         errors.append(f"{platform}: rc={rc} {tail[0][:200]}")

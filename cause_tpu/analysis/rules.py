@@ -675,6 +675,44 @@ def check_obs004(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                     "hoist it off the traced path)")
 
 
+_COSTMODEL_APIS = frozenset(
+    {"record_dispatch", "register_program", "note_delta_ops",
+     "note_full_bag", "wave_begin", "wave_abandon", "wave_cost",
+     "costmodel_digest", "cost_vs_divergence", "gap_report"}
+)
+
+
+@rule("OBS005",
+      "costmodel API reached from jit-reachable code without an "
+      "obs.enabled() guard (the wave cost model takes locks and "
+      "assembles dispatch/divergence records the moment obs is on)")
+def check_obs005(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]):
+                # costmodel.enabled() IS the sanctioned guard
+                continue
+            is_costmodel = (
+                parts[-1] in _COSTMODEL_APIS
+                or any(p in ("costmodel", "_costmodel", "_cm")
+                       for p in parts[:-1])
+            )
+            if is_costmodel and not guarded:
+                yield _finding(
+                    "OBS005", module, call,
+                    f"costmodel.{parts[-1]}() on a jit-reachable path "
+                    "without an obs.enabled() guard — unlike the "
+                    "no-op span/counter factories, the cost model "
+                    "takes registry locks and builds per-wave "
+                    "dispatch records when obs is on; gate the call "
+                    "(or hoist it off the traced path)")
+
+
 # ----------------------------------------------------------------- LCA
 
 @rule("LCA001",

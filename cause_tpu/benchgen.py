@@ -206,11 +206,29 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
     # "xla"-onto-unset collapse for non-defaulted switches; the
     # mapping lives in switches.py next to resolve() so key and
     # trace-time resolution cannot drift.
+    from .obs import costmodel as _costmodel
     from .obs import counter as _obs_counter, span as _obs_span
     from .switches import raw_switch_key
 
     key = (k_max, kernel if k_max > 0 else "v1", u_max,
            raw_switch_key())
+
+    def _prog_id():
+        # the ONE spelling of this program's costmodel identity: the
+        # dispatch record (below) and the devprof cost registration
+        # (miss branch) must agree byte-for-byte or the wave.cost
+        # devprof join silently misses
+        return (f"scalar:{key[1]}:k{int(k_max)}:u{int(u_max)}"
+                f":s{hash(key[3]) & 0xFFFFFFFF:08x}")
+
+    if _costmodel.enabled():
+        # dispatch accounting (obs.costmodel): every call here is ONE
+        # device program invocation under this switch-aware identity,
+        # hit or miss — the wave cost model counts invocations and
+        # distinct identities per wave window. Never feeds back into
+        # ``key``: the identity contract stays one-way, like the
+        # hit/miss counters below.
+        _costmodel.record_dispatch(_prog_id(), site="benchgen")
     program = _scalar_programs.get(key)
     if program is None:
         # program-cache provenance: every miss is a fresh trace (and on
@@ -341,6 +359,10 @@ def merge_wave_scalar(*args, k_max: int = 0, kernel: str = "v2",
                     u_max=int(u_max))
                 if prof is not None:
                     program = prof
+                    # price this program identity for the wave cost
+                    # model: wave.cost events attach the flops/bytes
+                    # of the programs a wave actually ran
+                    _costmodel.register_program(_prog_id(), prof.cost)
             _scalar_programs[key] = program
             return program(*args)
     _obs_counter("program_cache.hit").inc()

@@ -22,10 +22,12 @@ from .core import (
     span,
 )
 from .perfetto import export_perfetto, load_jsonl, to_chrome_trace
+from . import costmodel
 from . import semantic
 
 __all__ = [
     "configure",
+    "costmodel",
     "counter",
     "counters_snapshot",
     "enabled",

@@ -4,6 +4,7 @@
     python -m cause_tpu.obs stages [--smoke] [--reps N]  # stage ladder
     python -m cause_tpu.obs ledger --check               # perf ledger
     python -m cause_tpu.obs fleet events.jsonl           # fleet health
+    python -m cause_tpu.obs gap [--obs events.jsonl]     # gap report
 
 The default (first) form converts an obs JSONL event stream to a
 Perfetto trace — open the output at https://ui.perfetto.dev (or
@@ -37,6 +38,10 @@ def main(argv=None) -> int:
         from .fleet import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "gap":
+        from .costmodel import main as gap_main
+
+        return gap_main(argv[1:])
     return _convert_main(argv)
 
 

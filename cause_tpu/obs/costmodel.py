@@ -1,0 +1,668 @@
+"""The wave cost model: dispatch accounting joined to divergence.
+
+PERF.md's whole thesis — waves pay full-document-width cost even when
+replicas diverge by a handful of ops — lived in prose. PR 5's semantic
+events measure divergence per wave (delta rounds, token headroom) and
+PR 4's devprof prices programs at compile time, but nothing joined
+them into one record, so "cost ∝ document size, not divergence" had no
+machine-checkable artifact and the planned delta-native device weave
+(ROADMAP item 1) had no ready-made acceptance gate. This module is
+that join, in three layers:
+
+- **dispatch accounting** — every device program invocation at the
+  program-cache call sites (``benchgen.merge_wave_scalar``), the wave
+  kernels (``parallel/wave.py``) and the session's resident-splice
+  path (``parallel/session.py``) lands via :func:`record_dispatch`
+  with a program-identity string; the open wave window counts
+  invocations and distinct identities, and the dispatch-floor budget
+  arithmetic PERF.md narrates (floor_ms × dispatches vs measured
+  wall) becomes computed fields instead of prose;
+- **the cost-vs-divergence join** — each wave emits ONE ``wave.cost``
+  event carrying the wave's semantic evidence (delta ops noted by the
+  sync layer and the session delta path, token budget used, full-bag
+  count) NEXT TO its cost (dispatches, the devprof flops/bytes digest
+  of the programs run when known, wall span), so any obs stream
+  directly yields the cost-vs-divergence curve that motivates — and
+  later gates — the delta-native weave;
+- **the gap report** — ``python -m cause_tpu.obs gap`` reads the
+  committed perf ledger plus any obs JSONL stream and renders the
+  north-star decomposition: best same-platform headline, the dispatch
+  -floor share, per-phase shares from ``stages.prefix`` events when
+  present, the cost-vs-divergence slope with an explicit
+  O(doc)-vs-O(delta) verdict, and the projected headline if cost
+  scaled with the measured divergence.
+
+Contract (same as the rest of ``cause_tpu.obs``): stdlib + core only,
+importable without jax/numpy; with ``CAUSE_TPU_OBS`` unset every entry
+point returns immediately — no records, no registry state, no
+``TRACE_SWITCHES`` reads, byte-identical program-cache keys (pinned by
+tests/test_costmodel.py). On jit-reachable paths, call sites must sit
+behind ``obs.enabled()`` guards — causelint rule OBS005 gates that.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from . import core
+
+__all__ = [
+    "DISPATCH_FLOOR_MS",
+    "DISPATCH_FLOOR_RANGE_MS",
+    "NORTH_STAR_MS",
+    "enabled",
+    "reset",
+    "register_program",
+    "record_dispatch",
+    "note_delta_ops",
+    "note_full_bag",
+    "wave_begin",
+    "wave_abandon",
+    "wave_cost",
+    "costmodel_digest",
+    "cost_vs_divergence",
+    "gap_report",
+    "render_gap",
+]
+
+# The axon tunnel's measured per-dispatch floor (PERF.md: "a measured
+# ~64-70 ms dispatch floor is included in every number"). The midpoint
+# is the budget constant; the range states the measurement honestly.
+DISPATCH_FLOOR_RANGE_MS = (64.0, 70.0)
+DISPATCH_FLOOR_MS = sum(DISPATCH_FLOOR_RANGE_MS) / 2.0
+# BASELINE.json config 5: p50 < 100 ms on one chip.
+NORTH_STAR_MS = 100.0
+
+# verdict rule: over the observed divergence range, the fitted slope
+# must move the cost by at least this fraction OF THE MEAN COST before
+# the curve counts as O(delta) — i.e. cost must scale MATERIALLY with
+# divergence, not merely correlate (a 3 ms drift on a 1000 ms wave is
+# O(doc) however tight its fit). Below the threshold the wave is
+# paying document width regardless of divergence.
+_SLOPE_EXPLAINS = 0.5
+
+_LOCK = threading.Lock()
+# program identity -> devprof cost dict ({"flops", "bytes_accessed",
+# ...}); bounded LRU — identities are few (one per compiled program)
+_PROGRAMS: Dict[str, dict] = {}
+_PROGRAMS_MAX = 512
+# uuid -> host-side divergence evidence noted since that document's
+# last wave.cost (sync deltas, full-bag fallbacks); bounded like the
+# semantic monitor — a soak mints a uuid per round
+_PENDING_OPS: Dict[str, int] = {}
+_PENDING_BAGS: Dict[str, int] = {}
+_PENDING_MAX = 4096
+_TLS = threading.local()  # .window — the open per-thread wave window
+
+
+def enabled() -> bool:
+    """Whether the cost model records anything (== ``obs.enabled()``)."""
+    return core.enabled()
+
+
+def reset() -> None:
+    """Drop all cost-model state (tests; obs.reset does not reach into
+    this layer)."""
+    with _LOCK:
+        _PROGRAMS.clear()
+        _PENDING_OPS.clear()
+        _PENDING_BAGS.clear()
+    _TLS.window = None
+
+
+def _bound(d: Dict, cap: int) -> None:
+    while len(d) > cap:
+        d.pop(next(iter(d)))
+
+
+# --------------------------------------------------------- accounting
+
+
+def register_program(program: str, cost: Optional[dict]) -> None:
+    """Remember a compiled program's devprof cost digest under its
+    identity string, so later ``wave.cost`` events can attach the
+    flops/bytes of the programs a wave actually ran. Called at the
+    program-cache miss right after ``devprof.profile_program``."""
+    if not core.enabled():
+        return
+    with _LOCK:
+        _PROGRAMS.pop(program, None)
+        _PROGRAMS[program] = dict(cost or {})
+        _bound(_PROGRAMS, _PROGRAMS_MAX)
+
+
+def record_dispatch(program: str, site: str = "", n: int = 1) -> None:
+    """One (or ``n``) device program invocation(s) with identity
+    ``program``. Bumps the global ``costmodel.dispatches`` counter and
+    attributes the invocation to the calling thread's open wave
+    window, when one is open (dispatches outside any wave — session
+    splices, bench warmups — still count globally)."""
+    if not core.enabled():
+        return
+    core.counter("costmodel.dispatches").inc(n)
+    if site:
+        core.counter(f"costmodel.dispatches.{site}").inc(n)
+    w = getattr(_TLS, "window", None)
+    if w is not None:
+        w["dispatches"] += int(n)
+        w["programs"].add(str(program))
+
+
+def note_delta_ops(uuid: str, n: int) -> None:
+    """Host-side divergence evidence: ``n`` delta ops (synced nodes,
+    appended lanes) landed on document ``uuid`` since its last wave.
+    Drained into the next ``wave.cost`` for that document, so the
+    event's ``delta_ops`` matches the semantic stream's delta
+    accounting."""
+    if not core.enabled():
+        return
+    u = str(uuid)
+    with _LOCK:
+        _PENDING_OPS[u] = _PENDING_OPS.pop(u, 0) + int(n)
+        _bound(_PENDING_OPS, _PENDING_MAX)
+
+
+def note_full_bag(uuid: str, n: int = 1) -> None:
+    """A full-bag (O(doc) resend) degradation landed on ``uuid`` since
+    its last wave; drained into the next ``wave.cost`` like
+    :func:`note_delta_ops`."""
+    if not core.enabled():
+        return
+    u = str(uuid)
+    with _LOCK:
+        _PENDING_BAGS[u] = _PENDING_BAGS.pop(u, 0) + int(n)
+        _bound(_PENDING_BAGS, _PENDING_MAX)
+
+
+# ------------------------------------------------------- wave windows
+
+
+def wave_begin(source: str) -> Optional[dict]:
+    """Open this thread's wave window: subsequent
+    :func:`record_dispatch` calls attribute to it until
+    :func:`wave_cost` closes it. Re-entrant by replacement — a window
+    leaked by a raised wave is simply superseded."""
+    if not core.enabled():
+        return None
+    w = {"source": str(source), "t0": time.perf_counter(),
+         "dispatches": 0, "programs": set()}
+    _TLS.window = w
+    return w
+
+
+def wave_abandon() -> None:
+    """Drop the open window without emitting (overflowed session waves:
+    their digests are garbage and ``fleet.session_overflow`` already
+    records the incident)."""
+    _TLS.window = None
+
+
+def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
+              tokens: Optional[int] = None, token_budget: int = 0,
+              delta_ops: int = 0, full_bag: int = 0,
+              poisoned: int = 0, overflow_retries: int = 0,
+              semantic: Optional[dict] = None) -> Optional[dict]:
+    """Close the open wave window and emit ONE ``wave.cost`` event —
+    the per-wave join of cost and divergence:
+
+    - cost: ``dispatches`` / distinct ``programs`` from the window,
+      wall span since :func:`wave_begin`, the dispatch-floor budget
+      (``floor_budget_ms = DISPATCH_FLOOR_MS × dispatches`` — the
+      minimum a tunnel-floored chip pays for this wave regardless of
+      kernel speed), and the devprof flops/bytes sum of the programs
+      run where :func:`register_program` priced them;
+    - divergence: ``delta_ops`` (the caller's directly-measured ops —
+      session delta lanes — plus everything :func:`note_delta_ops`
+      accumulated for ``uuid``), ``tokens`` used vs ``token_budget``,
+      ``full_bag`` count (caller's fallbacks plus noted full bags),
+      and the wave's semantic summary (``wave.digest`` fields) when
+      given;
+    - scale: ``pairs`` and ``lanes`` (the O(doc) axis the divergence
+      fields are judged against).
+
+    Returns the emitted fields (or None when obs is off / no window).
+    """
+    if not core.enabled():
+        return None
+    w = getattr(_TLS, "window", None)
+    _TLS.window = None
+    if w is None:
+        return None
+    wall_ms = (time.perf_counter() - w["t0"]) * 1000.0
+    u = str(uuid)
+    with _LOCK:
+        pend_ops = _PENDING_OPS.pop(u, 0)
+        pend_bags = _PENDING_BAGS.pop(u, 0)
+        devprof_sum: Dict[str, float] = {}
+        for p in w["programs"]:
+            for k, v in (_PROGRAMS.get(p) or {}).items():
+                if isinstance(v, (int, float)):
+                    devprof_sum[k] = devprof_sum.get(k, 0) + v
+    dispatches = int(w["dispatches"])
+    fields: dict = {
+        "uuid": u,
+        "source": w["source"],
+        "pairs": int(pairs),
+        "lanes": int(lanes),
+        "delta_ops": int(delta_ops) + pend_ops,
+        "full_bag": int(full_bag) + pend_bags,
+        "poisoned": int(poisoned),
+        "overflow_retries": int(overflow_retries),
+        "dispatches": dispatches,
+        "programs": len(w["programs"]),
+        "wall_ms": round(wall_ms, 3),
+        "floor_ms": DISPATCH_FLOOR_MS,
+        "floor_budget_ms": round(DISPATCH_FLOOR_MS * dispatches, 3),
+    }
+    if tokens is not None:
+        fields["tokens"] = int(tokens)
+        fields["token_budget"] = int(token_budget)
+    if devprof_sum:
+        fields["devprof"] = devprof_sum
+    if semantic:
+        # the divergence join proper: the wave.digest summary rides
+        # next to the cost numbers (agreed/distinct/valid — staleness
+        # histograms stay on the wave.digest event itself)
+        fields["semantic"] = {
+            k: semantic[k]
+            for k in ("agreed", "distinct", "valid", "wave")
+            if k in semantic
+        }
+    core.event("wave.cost", **fields)
+    core.counter("costmodel.waves").inc()
+    # Perfetto counter tracks: each set lands as a timestamped gauge
+    # event, so dispatches and divergence render as curves next to the
+    # wave spans they price
+    core.gauge("costmodel.dispatches.wave").set(dispatches)
+    core.gauge("costmodel.delta_ops.wave").set(fields["delta_ops"])
+    if tokens is not None:
+        core.gauge("costmodel.tokens.wave").set(int(tokens))
+    return fields
+
+
+# ---------------------------------------------------------- analysis
+
+
+def _wave_cost_events(events: Sequence[dict]) -> List[dict]:
+    return [e.get("fields") or {} for e in events
+            if e.get("ev") == "event" and e.get("name") == "wave.cost"]
+
+
+def _divergence_of(f: dict) -> Optional[int]:
+    """The wave's divergence measure: delta ops where the stream
+    recorded them (zero counts — a converged wave that still paid
+    full cost is the strongest O(doc) evidence), else the kernel's
+    token count (the segment-union work size — divergent regions
+    explode to tokens, the shared base dedupes). A full-bag wave with
+    no delta count is excluded: its divergence was shipped as O(doc),
+    not measured."""
+    if f.get("delta_ops"):
+        return int(f["delta_ops"])
+    if f.get("full_bag"):
+        # full-bag work with no delta count: divergence was shipped
+        # as O(doc), never measured — the tokens of the surviving
+        # live rows would understate it
+        return None
+    if f.get("tokens"):
+        return int(f["tokens"])
+    if "delta_ops" in f:
+        return 0
+    return None
+
+
+def cost_vs_divergence(waves: Sequence[dict]) -> dict:
+    """Least-squares fit of wave cost (wall ms) against wave
+    divergence over a stream of ``wave.cost`` fields, with the
+    explicit O(doc)-vs-O(delta) verdict the delta-native roadmap item
+    gates on:
+
+    - ``O(delta)`` — over the observed divergence range the fitted
+      slope moves the cost by at least half its MEAN: cost scales
+      materially with divergence;
+    - ``O(doc)`` — it does not: waves pay document-width cost however
+      small the divergence (the PERF.md claim, now computed — a tiny
+      correlated drift on a large flat cost stays O(doc));
+    - ``insufficient-data`` — fewer than two waves, or no divergence
+      spread to regress over.
+    """
+    pts = []
+    for f in waves:
+        x = _divergence_of(f)
+        y = f.get("wall_ms")
+        if x is not None and isinstance(y, (int, float)):
+            pts.append((float(x), float(y)))
+    out: dict = {"points": len(pts)}
+    if len(pts) < 2:
+        out["verdict"] = "insufficient-data"
+        return out
+    xs = [p[0] for p in pts]
+    ys = [p[1] for p in pts]
+    n = len(pts)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    sxx = sum((x - mx) ** 2 for x in xs)
+    syy = sum((y - my) ** 2 for y in ys)
+    sxy = sum((x - mx) * (y - my) for x, y in pts)
+    out.update(
+        divergence_min=min(xs), divergence_max=max(xs),
+        cost_min_ms=round(min(ys), 3), cost_max_ms=round(max(ys), 3),
+        mean_cost_ms=round(my, 3),
+    )
+    if sxx == 0:
+        out["verdict"] = "insufficient-data"
+        return out
+    slope = sxy / sxx
+    intercept = my - slope * mx
+    corr = (sxy / (sxx * syy) ** 0.5) if syy > 0 else 0.0
+    # how far the divergence slope moves the cost over the observed
+    # range, relative to the MEAN cost (see _SLOPE_EXPLAINS): a
+    # negative slope is noise, not delta-scaling
+    explained = max(slope, 0.0) * (max(xs) - min(xs))
+    ratio = explained / my if my > 0 else 0.0
+    out.update(
+        slope_ms_per_op=round(slope, 6),
+        intercept_ms=round(intercept, 3),
+        corr=round(corr, 4),
+        explained_ratio=round(ratio, 4),
+        verdict="O(delta)" if ratio >= _SLOPE_EXPLAINS else "O(doc)",
+    )
+    return out
+
+
+def costmodel_digest(events: Sequence[dict]) -> dict:
+    """The cost-model aggregate of one obs stream — the ledger row
+    extension (``row["cost"]``): wave/dispatch totals, divergence
+    totals, and the slope verdict. Empty dict when the stream carries
+    no ``wave.cost`` events."""
+    waves = _wave_cost_events(events)
+    if not waves:
+        return {}
+    out = {
+        "waves": len(waves),
+        "dispatches": sum(int(f.get("dispatches") or 0) for f in waves),
+        "delta_ops": sum(int(f.get("delta_ops") or 0) for f in waves),
+        "full_bag": sum(int(f.get("full_bag") or 0) for f in waves),
+        "wall_ms": round(sum(float(f.get("wall_ms") or 0.0)
+                             for f in waves), 3),
+        "lanes_max": max(int(f.get("lanes") or 0) for f in waves),
+    }
+    curve = cost_vs_divergence(waves)
+    out["slope"] = curve
+    return out
+
+
+# --------------------------------------------------------- gap report
+
+
+def _best_bench_rows(rows: Sequence[dict]) -> Dict[str, dict]:
+    """Best (lowest headline) non-quarantined full-size bench row per
+    platform string — the only rows a headline claim may cite."""
+    best: Dict[str, dict] = {}
+    for r in rows:
+        if (r.get("kind") or "bench") != "bench":
+            continue
+        if r.get("quarantined") or r.get("smoke"):
+            continue
+        v = r.get("value_ms")
+        if not isinstance(v, (int, float)):
+            continue
+        p = str(r.get("platform") or "?")
+        if p not in best or v < best[p]["value_ms"]:
+            best[p] = r
+    return best
+
+
+def _stage_shares(events: Sequence[dict]) -> List[dict]:
+    """Per-phase shares from ``stages.prefix`` events (the jaxw5 stage
+    ladder), when the stream carries them: each stage's delta over the
+    FULL prefix's p50. Last ladder wins (streams may hold several)."""
+    ladder: Dict[str, dict] = {}
+    for e in events:
+        if e.get("ev") == "event" and e.get("name") == "stages.prefix":
+            f = e.get("fields") or {}
+            if f.get("stage") and f.get("p50_ms") is not None:
+                ladder[str(f["stage"])] = f
+    if not ladder:
+        return []
+    full = ladder.get("FULL") or max(
+        ladder.values(), key=lambda f: f["p50_ms"])
+    total = float(full["p50_ms"]) or 1.0
+    out = []
+    for name, f in ladder.items():
+        delta = float(f.get("delta_ms") or 0.0)
+        out.append({"stage": name, "delta_ms": round(delta, 3),
+                    "share": round(delta / total, 4)})
+    out.sort(key=lambda d: -d["delta_ms"])
+    return out
+
+
+def gap_report(rows: Sequence[dict],
+               events: Optional[Sequence[dict]] = None,
+               target_ms: float = NORTH_STAR_MS,
+               floor_ms: float = DISPATCH_FLOOR_MS) -> dict:
+    """The north-star decomposition from the perf ledger plus an
+    optional obs stream. Total on empty inputs (every section states
+    its absence) — the first question to a broken run is "is there any
+    evidence at all?"."""
+    events = list(events or [])
+    best = _best_bench_rows(rows)
+    head = best.get("tpu")
+    head_note = ""
+    if head is None and best:
+        head = min(best.values(), key=lambda r: r["value_ms"])
+        head_note = ("no tpu row in the ledger; best available "
+                     "platform shown — the 100 ms target is defined "
+                     "on tpu")
+    waves = _wave_cost_events(events)
+    report: dict = {
+        "target_ms": target_ms,
+        "floor_ms": floor_ms,
+        "floor_range_ms": list(DISPATCH_FLOOR_RANGE_MS),
+        "ledger_rows": len(rows),
+        "stream_waves": len(waves),
+        "platforms": {
+            p: {"value_ms": r["value_ms"],
+                "single_dispatch_ms": r.get("single_dispatch_ms"),
+                "kernel": r.get("kernel"), "source": r.get("source")}
+            for p, r in sorted(best.items())
+        },
+    }
+    if head is not None:
+        single = head.get("single_dispatch_ms")
+        report["headline"] = {
+            "value_ms": head["value_ms"],
+            "single_dispatch_ms": single,
+            "platform": head.get("platform"),
+            "kernel": head.get("kernel"),
+            "source": head.get("source"),
+            "gap_x": round(float(head["value_ms"]) / target_ms, 2),
+        }
+        if head_note:
+            report["headline"]["note"] = head_note
+        # dispatch-floor arithmetic, lifted from PERF.md prose: the
+        # floor's share of a single dispatch (amortized bursts pay it
+        # once per burst), and the per-wave floor budget under the
+        # stream's measured dispatches-per-wave
+        dpw = None
+        if waves:
+            ds = sorted(int(f.get("dispatches") or 0) for f in waves)
+            dpw = ds[len(ds) // 2]
+        report["dispatch_floor"] = {
+            "floor_ms": floor_ms,
+            "dispatches_per_wave": dpw,
+            "floor_budget_ms": (round(floor_ms * dpw, 3)
+                                if dpw is not None else floor_ms),
+            "share_of_single": (
+                round(floor_ms / float(single), 4)
+                if isinstance(single, (int, float)) and single else None),
+            "share_of_target": round(floor_ms / target_ms, 4),
+        }
+    else:
+        report["headline"] = None
+    stages = _stage_shares(events)
+    if stages:
+        report["stages"] = stages
+    curve = cost_vs_divergence(waves)
+    report["cost_vs_divergence"] = curve
+    # projection: if wave cost scaled with the measured divergence
+    # (the delta-native weave's promise), the headline would shrink to
+    # its divergence fraction — floored by the dispatch floor, which
+    # no kernel can amortize below one dispatch
+    fracs = [(_divergence_of(f) or 0) / float(f["lanes"])
+             for f in waves
+             if f.get("lanes") and _divergence_of(f) is not None]
+    if head is not None and fracs:
+        fracs.sort()
+        frac = fracs[len(fracs) // 2]
+        projected = max(floor_ms, float(head["value_ms"]) * frac)
+        report["projected"] = {
+            "divergence_fraction": round(frac, 6),
+            "headline_ms": round(projected, 3),
+            "gap_x": round(projected / target_ms, 2),
+            "assumes": "cost scales with measured divergence "
+                       "(the delta-native weave contract)",
+        }
+    return report
+
+
+def render_gap(report: dict) -> str:
+    """The human layout of :func:`gap_report` — one glanceable
+    decomposition block."""
+    lines = [f"north-star gap (target {report['target_ms']:g} ms, "
+             f"dispatch floor {report['floor_ms']:g} ms "
+             f"[{report['floor_range_ms'][0]:g}-"
+             f"{report['floor_range_ms'][1]:g}])"]
+    head = report.get("headline")
+    if head is None:
+        lines.append("  headline: NO eligible bench row in the ledger "
+                     "(nothing non-quarantined at full size)")
+    else:
+        lines.append(
+            f"  headline: {head['value_ms']:g} ms amortized "
+            f"({head['platform']}, {head['kernel']}, {head['source']})"
+            f" = {head['gap_x']:g}x off target")
+        if head.get("note"):
+            lines.append(f"    note: {head['note']}")
+        if head.get("single_dispatch_ms"):
+            lines.append(f"  single dispatch: "
+                         f"{head['single_dispatch_ms']:g} ms")
+        fl = report.get("dispatch_floor") or {}
+        if fl:
+            share = fl.get("share_of_single")
+            lines.append(
+                f"  dispatch floor: {fl['floor_budget_ms']:g} ms/wave"
+                + (f" ({fl['dispatches_per_wave']} dispatch(es)/wave)"
+                   if fl.get("dispatches_per_wave") is not None else "")
+                + (f", {100 * share:.1f}% of a single dispatch"
+                   if share is not None else "")
+                + f", {100 * fl['share_of_target']:.0f}% of the target")
+    for st in report.get("stages", []):
+        lines.append(f"  phase {st['stage']}: {st['delta_ms']:g} ms "
+                     f"({100 * st['share']:.1f}%)")
+    c = report.get("cost_vs_divergence") or {}
+    if c.get("verdict") == "insufficient-data":
+        lines.append(f"  cost vs divergence: insufficient data "
+                     f"({c.get('points', 0)} wave(s) in the stream)")
+    elif c:
+        lines.append(
+            f"  cost vs divergence: {c['points']} waves, divergence "
+            f"{c['divergence_min']:g}-{c['divergence_max']:g} ops, "
+            f"slope {c['slope_ms_per_op']:g} ms/op "
+            f"(corr {c['corr']:g}, explains "
+            f"{100 * c['explained_ratio']:.0f}% of spread) -> "
+            f"verdict: {c['verdict']}")
+    proj = report.get("projected")
+    if proj:
+        lines.append(
+            f"  projected if cost ∝ divergence: {proj['headline_ms']:g}"
+            f" ms ({proj['gap_x']:g}x target; measured divergence "
+            f"fraction {proj['divergence_fraction']:g})")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------------- CLI
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    import os
+    import sys
+
+    from . import ledger as ledger_mod
+    from .perfetto import load_jsonl
+
+    ap = argparse.ArgumentParser(
+        prog="python -m cause_tpu.obs gap",
+        description="Render the north-star gap decomposition from the "
+                    "committed perf ledger plus any obs JSONL streams "
+                    "(dispatch-floor share, per-phase shares, the "
+                    "cost-vs-divergence slope with its O(doc)-vs-"
+                    "O(delta) verdict, and the projected headline if "
+                    "cost scaled with divergence).")
+    ap.add_argument("--ledger", default="",
+                    help="ledger path (default: CAUSE_TPU_LEDGER or "
+                         "measurements/ledger.jsonl)")
+    ap.add_argument("--obs", action="append", default=[],
+                    help="obs JSONL stream(s) carrying wave.cost / "
+                         "stages.prefix events (repeatable)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    ap.add_argument("--append", action="store_true",
+                    help="also land the report as a --kind gap summary "
+                         "row in the ledger (platform-partitioned like "
+                         "every other row)")
+    ap.add_argument("--source", default="obs-gap",
+                    help="source tag for the --append row")
+    ap.add_argument("--target", type=float, default=NORTH_STAR_MS,
+                    help="target ms (default: the 100 ms north star)")
+    ap.add_argument("--floor", type=float, default=DISPATCH_FLOOR_MS,
+                    help="dispatch floor ms (default: the measured "
+                         "tunnel floor midpoint)")
+    a = ap.parse_args(argv)
+
+    rows = ledger_mod.load(a.ledger or None)
+    events: List[dict] = []
+    for path in a.obs:
+        if not os.path.exists(path):
+            print(f"gap: no such obs stream: {path}", file=sys.stderr)
+            return 2
+        events.extend(load_jsonl(path))
+    report = gap_report(rows, events, target_ms=a.target,
+                        floor_ms=a.floor)
+    if a.json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(render_gap(report))
+    if a.append:
+        head = report.get("headline") or {}
+        platform = head.get("platform")
+        if not platform:
+            # no headline in the target ledger (fresh/scratch): tag
+            # the row with the stream's own platform so it still
+            # partitions honestly instead of quarantining as "none"
+            plats = [e.get("platform") for e in events
+                     if e.get("ev") == "event"
+                     and e.get("name") == "wave.cost"
+                     and e.get("platform")]
+            platform = plats[0] if plats else "none"
+        row = ledger_mod.ingest_record(
+            {"platform": platform,
+             "metric": f"north-star gap decomposition "
+                       f"(target {a.target:g} ms)",
+             "value": None,
+             "kernel": head.get("kernel"),
+             "config": "gap-report"},
+            source=a.source, path=a.ledger or None, kind="gap",
+            extra={"gap": report},
+        )
+        print(f"gap: ledger row ({row['platform']}) -> "
+              f"{a.ledger or ledger_mod.default_path()}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -58,6 +58,7 @@ __all__ = [
     "append",
     "normalize_bench",
     "devprof_digest",
+    "costmodel_row_digest",
     "ingest",
     "ingest_record",
     "backfill",
@@ -205,20 +206,23 @@ def devprof_digest(obs_jsonl: str) -> dict:
 
 def ingest_record(rec: dict, source: str = "", obs_jsonl: str = "",
                   path: Optional[str] = None,
-                  kind: str = "bench") -> dict:
+                  kind: str = "bench",
+                  extra: Optional[dict] = None) -> dict:
     """Append one already-parsed artifact record as a normalized row,
     with the sidecar's devprof/counter digest when an obs JSONL is
     given. The in-memory half of ``ingest()`` — bench.py holds its
     artifact line already parsed and must not round-trip it through a
-    temp file just to land a ledger row."""
+    temp file just to land a ledger row. ``extra`` merges additional
+    row fields verbatim (the gap CLI's ``--kind gap`` summary rides
+    here) without ever overriding the normalized provenance keys."""
     row = normalize_bench(rec, source=source)
     row["kind"] = kind
     if kind != "bench":
-        # harvest/soak artifacts carry no bench-shaped value_ms, so
-        # the bench heuristic would quarantine every one of them and
-        # the deterministic-metric gate would be silently inert for
-        # two of the three advertised kinds — for non-bench rows only
-        # a fallback platform quarantines
+        # harvest/soak/gap artifacts carry no bench-shaped value_ms,
+        # so the bench heuristic would quarantine every one of them
+        # and the deterministic-metric gate would be silently inert
+        # for the non-bench kinds — for those rows only a fallback
+        # platform quarantines
         row["quarantined"] = bool(row["fallback"])
     if obs_jsonl:
         digest = devprof_digest(obs_jsonl)
@@ -226,7 +230,25 @@ def ingest_record(rec: dict, source: str = "", obs_jsonl: str = "",
             row["devprof"] = digest["devprof"]
         if digest.get("counters"):
             row["counters"] = digest["counters"]
+        cost = costmodel_row_digest(obs_jsonl)
+        if cost:
+            row["cost"] = cost
+    if extra:
+        for k, v in extra.items():
+            row.setdefault(k, v)
     return append(row, path)
+
+
+def costmodel_row_digest(obs_jsonl: str) -> dict:
+    """The cost-model extension of a ledger row: the sidecar's
+    ``wave.cost`` aggregate (waves, dispatches, delta ops, slope
+    verdict — ``costmodel.costmodel_digest``). Empty when the stream
+    carries no wave.cost events, so pre-PR-6 ingests are unchanged."""
+    if not obs_jsonl or not os.path.exists(obs_jsonl):
+        return {}
+    from .costmodel import costmodel_digest
+
+    return costmodel_digest(load_jsonl(obs_jsonl))
 
 
 def ingest(artifact_path: str, source: str = "",
@@ -443,7 +465,10 @@ def main(argv=None) -> int:
     ap.add_argument("--source", default="",
                     help="source tag for --ingest rows")
     ap.add_argument("--kind", default="bench",
-                    help="row kind for --ingest (bench/harvest/soak)")
+                    help="row kind for --ingest (bench/harvest/soak/"
+                         "gap — gap rows carry a north-star summary "
+                         "and gate like any non-bench kind: platform-"
+                         "partitioned, quarantined only on fallback)")
     ap.add_argument("--check", action="store_true",
                     help="regression verdict; exit 1 on any regression")
     a = ap.parse_args(argv)

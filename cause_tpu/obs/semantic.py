@@ -66,6 +66,7 @@ __all__ = [
 SEMANTIC_EVENT_PREFIXES = (
     "sync.",
     "wave.digest",
+    "wave.cost",
     "divergence",
     "gc.",
     "collection.",

@@ -123,6 +123,11 @@ class FleetSession:
         self.u_max = 0
         self._u_headroom = float(u_headroom)
         self.dev = None
+        # wave cost-model bookkeeping: what the LAST update shipped
+        # (delta lanes vs a full O(doc) re-upload) — the next wave()'s
+        # wave.cost event carries it as divergence evidence
+        self._last_delta_lanes = 0
+        self._last_update_full = False
         self._full_upload(pairs)
 
     # ------------------------------------------------------------------
@@ -192,6 +197,10 @@ class FleetSession:
         ]
         self._gen = views[0][0].interner.generation
         self.pairs = list(pairs)
+        # a full upload is the session's O(doc) degradation: the next
+        # wave.cost records it as a full-bag wave with zero delta ops
+        self._last_delta_lanes = 0
+        self._last_update_full = True
         if obs.enabled():
             from ..obs import devprof
 
@@ -314,6 +323,17 @@ class FleetSession:
             jnp.asarray(starts), jnp.asarray(counts),
             jnp.asarray(b_shift), jnp.asarray(old_nb),
         )
+        if obs.enabled():
+            # the resident-splice program is a device dispatch too —
+            # it runs outside any wave window (update-time), so it
+            # counts globally; the spliced lane total is the wave's
+            # measured divergence and rides the NEXT wave.cost
+            from ..obs import costmodel as _cm
+
+            _cm.record_dispatch(f"session:splice:d{self.d_max}",
+                                site="session")
+        self._last_delta_lanes = int(counts.sum())
+        self._last_update_full = False
         for k in SEG_LANE_KEYS:
             self.dev[k] = jnp.asarray(np.stack(tables[k]))
         self._views = views
@@ -327,6 +347,10 @@ class FleetSession:
         from ..benchgen import LANE_KEYS5
         from ..weaver.jaxw5 import batched_merge_weave_v5
 
+        if obs.enabled():
+            from ..obs import costmodel as _cm
+
+            _cm.wave_begin("session")
         with obs.span("session.wave", pairs=len(self.pairs),
                       u_max=int(self.u_max)):
             r, v, _c, ov = batched_merge_weave_v5(
@@ -334,6 +358,12 @@ class FleetSession:
                 u_max=self.u_max, k_max=self.u_max,
             )
             digest = _digest_fn()(self.dev["hi"], self.dev["lo"], r, v)
+            if obs.enabled():
+                from ..obs import costmodel as _cm
+
+                _cm.record_dispatch(f"session:v5:u{int(self.u_max)}",
+                                    site="session")
+                _cm.record_dispatch("session:digest", site="session")
             self.last_rank = r
             self.last_visible = v
             self.last_overflow = ov
@@ -348,10 +378,14 @@ class FleetSession:
             rows = np.flatnonzero(np.asarray(ov)).tolist()
             if obs.enabled():
                 # an overflowed wave's digests are garbage — record
-                # the incident, never feed them to the monitors
+                # the incident, never feed them to the monitors; the
+                # cost window is dropped too (fleet.session_overflow
+                # is the incident record)
+                from ..obs import costmodel as _cm
                 from ..obs import semantic as _sem
 
                 _sem.session_overflow(rows)
+                _cm.wave_abandon()
             raise s.CausalError(
                 "wave overflowed the session's token budget; raise "
                 "u_headroom or re-create the session",
@@ -360,9 +394,26 @@ class FleetSession:
         if obs.enabled():
             # every session digest is device-computed (overflow raised
             # above), so the whole wave feeds the divergence monitors
-            _observe_semantics(self.pairs, out,
-                               np.ones(len(self.pairs), bool),
-                               "session")
+            sem = _observe_semantics(self.pairs, out,
+                                     np.ones(len(self.pairs), bool),
+                                     "session")
+            # the cost-vs-divergence join, session flavor: delta ops
+            # are the lanes the LAST update actually spliced (zero
+            # after a full upload — that wave paid O(doc) transfer,
+            # recorded as full_bag)
+            from ..obs import costmodel as _cm
+
+            _cm.wave_cost(
+                uuid=str(self.pairs[0][0].ct.uuid),
+                pairs=len(self.pairs),
+                lanes=2 * int(self.capacity) * len(self.pairs),
+                token_budget=int(self.u_max) * len(self.pairs),
+                delta_ops=self._last_delta_lanes,
+                full_bag=1 if self._last_update_full else 0,
+                semantic=sem,
+            )
+            self._last_delta_lanes = 0
+            self._last_update_full = False
         return out
 
     def merged(self, i: int):

@@ -97,18 +97,20 @@ _PAD = {
 }
 
 
-def _observe_semantics(pairs, digests, valid, source: str) -> None:
+def _observe_semantics(pairs, digests, valid, source: str):
     """One wave's CRDT-semantic telemetry (``wave.digest`` agreement,
     per-pair staleness, ``divergence`` provenance) — obs-on callers
     only. The version-vector callback is lazy: vectors are built from
     the yarn caches only when a divergence actually needs
     first-differing-site provenance, never on the agreeing fast
-    path."""
+    path. Returns the wave summary fields (``observe_wave``'s dict)
+    so the cost model can join them onto its ``wave.cost`` event, or
+    None when obs is off."""
     from ..obs import semantic
     from ..sync import version_vector
 
     if not semantic.enabled():
-        return
+        return None
 
     def vv_of(i):
         # the merged pair's vector: pointwise max of both replicas'
@@ -120,8 +122,8 @@ def _observe_semantics(pairs, digests, valid, source: str) -> None:
                 vv[site] = h
         return vv
 
-    semantic.observe_wave(pairs[0][0].ct.uuid, digests, valid,
-                          vv_of=vv_of, source=source)
+    return semantic.observe_wave(pairs[0][0].ct.uuid, digests, valid,
+                                 vv_of=vv_of, source=source)
 
 # Lanes sampled per tree per wave by the body spot-check below.
 # CAUSE_TPU_BODY_SAMPLE=0 disables; a value >= the tree size checks
@@ -366,6 +368,13 @@ def merge_wave(pairs: Sequence[Tuple[object, object]],
 
 
 def _merge_wave(pairs, mesh, ctx) -> WaveResult:
+    if obs.enabled():
+        # open the wave cost window: device program invocations below
+        # attribute to it, and ONE wave.cost event joins them to the
+        # wave's divergence evidence on every exit path
+        from ..obs import costmodel as _cm
+
+        _cm.wave_begin("wave")
     for a, b in pairs:
         s.check_mergeable(a.ct, b.ct)
 
@@ -423,8 +432,17 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
         if obs.enabled():
             # the wave still happened; every pair ages (no device
             # digest converged it against the fleet's modal value)
-            _observe_semantics(pairs, np.zeros(B, np.uint32),
-                               np.zeros(B, bool), "wave")
+            sem = _observe_semantics(pairs, np.zeros(B, np.uint32),
+                                     np.zeros(B, bool), "wave")
+            # a degenerate wave (all pairs host-merged/poisoned) ran
+            # zero device programs: its wave.cost records that — the
+            # "dispatches >= 1" invariant holds for non-degenerate
+            # waves only
+            from ..obs import costmodel as _cm
+
+            _cm.wave_cost(uuid=str(pairs[0][0].ct.uuid), pairs=B,
+                          lanes=0, full_bag=len(fallback),
+                          poisoned=len(poisoned), semantic=sem)
         return WaveResult(pairs, views, 0,
                           np.zeros((B, 0), np.int32),
                           np.zeros((B, 0), bool),
@@ -481,6 +499,15 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
         )
         d = _digest_fn()(jnp.asarray(sub_lanes["hi"]),
                          jnp.asarray(sub_lanes["lo"]), r, v)
+        if obs.enabled():
+            # dispatch accounting: one kernel invocation plus one
+            # digest invocation per dispatch_v5 call, attributed to
+            # the open wave window
+            from ..obs import costmodel as _cm
+
+            _cm.record_dispatch(f"wave:{pipeline}:u{int(u)}",
+                                site="wave")
+            _cm.record_dispatch("wave:digest", site="wave")
         return (np.asarray(r), np.asarray(v), np.asarray(d),
                 np.asarray(ov))
 
@@ -515,8 +542,17 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
             visible = np.asarray(visible)
             digest = np.asarray(digest)
             overflow = np.asarray(overflow)
+            if obs.enabled():
+                # the sharded step computes kernel + digest in ONE
+                # compiled program
+                from ..obs import costmodel as _cm
+
+                _cm.record_dispatch(
+                    f"wave:sharded:{pipeline}:u{int(u_max)}",
+                    site="wave")
         else:
             rank, visible, digest, overflow = dispatch_v5(lanes, u_max)
+    n_retried = 0
     if overflow.any():
         # the token budget samples rows; a spiky unsampled row can
         # overflow. Retry just those rows (unsharded — a handful of
@@ -524,6 +560,7 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
         # resorting to host merges. np.array: jax host buffers can be
         # read-only.
         rows = np.flatnonzero(overflow)
+        n_retried = len(rows)
         obs.counter("wave.overflow_retry").inc(len(rows))
         obs.event("wave.overflow_retry", rows=len(rows),
                   u_max=int(u_max))
@@ -566,13 +603,28 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
     if obs.enabled():
         # semantic layer: digest agreement, staleness aging, and (on
         # disagreement) one divergence event with site provenance
-        _observe_semantics(pairs, full_dig, dig_valid, "wave")
+        sem = _observe_semantics(pairs, full_dig, dig_valid, "wave")
         # devprof wave-boundary sample: live device arrays + backend
         # memory after the dispatch settle, so per-wave residency
         # renders as a curve next to the dispatch spans
         from ..obs import devprof
 
         devprof.sample_device_memory("wave")
+        # the cost-vs-divergence join: ONE wave.cost event carrying
+        # this wave's dispatch count and program identities next to
+        # its token work size (the O(delta) axis), its lane width
+        # (the O(doc) axis) and the semantic digest summary
+        from ..obs import costmodel as _cm
+
+        # lanes/tokens are FLEET totals (lanes: the O(doc) transfer/
+        # scan width; tokens: worst-row estimate × rows — the kernel
+        # pads every row to the budget), same units as delta_ops
+        _cm.wave_cost(uuid=str(pairs[0][0].ct.uuid), pairs=B,
+                      lanes=2 * int(cap) * B,
+                      tokens=int(u_need) * len(live_views),
+                      token_budget=int(u_max) * len(live_views),
+                      full_bag=len(fallback), poisoned=len(poisoned),
+                      overflow_retries=n_retried, semantic=sem)
     return WaveResult(pairs, views, cap, full_rank, full_vis, full_dig,
                       fallback, pipeline, dig_valid,
                       poisoned=poisoned)

@@ -38,6 +38,7 @@ from typing import Dict, Optional, Tuple
 from . import obs
 from .collections import shared as s
 from . import serde
+from .obs import costmodel as _cm
 from .obs import semantic as _sem
 
 __all__ = [
@@ -132,6 +133,10 @@ def apply_delta(handle, nodes: dict, _count_as_delta: bool = True):
         _sem.sync_applied(len(nodes),
                           "incremental" if incremental else "union",
                           uuid=handle.ct.uuid)
+        # divergence evidence for the cost model: these ops accrue to
+        # the document and drain into its NEXT wave.cost event, so
+        # per-wave cost sits next to the sync layer's own accounting
+        _cm.note_delta_ops(handle.ct.uuid, len(nodes))
     return merged
 
 
@@ -295,6 +300,7 @@ def sync_stream(handle, stream):
             _sem.sync_full_bag(
                 "cause-must-exist" if not ok else "peer-resync",
                 uuid=ct.uuid)
+            _cm.note_full_bag(ct.uuid)
         full = exchange_frame(stream, {
             "op": "full", "nodes": serde.encode_node_items(dict(ct.nodes)),
         })
@@ -318,6 +324,7 @@ def sync_pair(a, b) -> Tuple[object, object]:
             # non-prefix history (weft, gapped replica): full bag
             if obs.enabled():
                 _sem.sync_full_bag("cause-must-exist", uuid=dst.ct.uuid)
+                _cm.note_full_bag(dst.ct.uuid)
             return apply_delta(dst, dict(src.ct.nodes),
                                _count_as_delta=False)
 

@@ -399,9 +399,28 @@ def main() -> None:
                 raise _Overflow(name)
             singles, bursts = [], []
             for _ in range(reps):
+                if obs.enabled():
+                    # each timed single is one wave: land its
+                    # wave.cost record (dispatch accounting + the
+                    # generator's KNOWN divergence of 2*ND suffix ops
+                    # per pair) so harvest sidecars feed the gap
+                    # report's cost-vs-divergence join
+                    from cause_tpu.obs import costmodel as _cm
+
+                    _cm.wave_begin("harvest")
                 t0 = time.perf_counter()
                 np.asarray(dispatch(kernel, k))
                 singles.append((time.perf_counter() - t0) * 1000)
+                if obs.enabled():
+                    from cause_tpu.obs import costmodel as _cm
+
+                    v5_family = kernel in ("v5", "v5w", "v5f")
+                    _cm.wave_cost(
+                        uuid=f"harvest:{name}", pairs=B,
+                        lanes=2 * CAP * B,
+                        tokens=k * B if v5_family else None,
+                        token_budget=k * B if v5_family else 0,
+                        delta_ops=2 * ND * B)
             # bench.py's adaptive-burst rule (window economy, and the
             # window-2 lesson — a slow kernel's 3 bursts are ~90 s of
             # window for nothing): when single > 1 s the ~64-70 ms

@@ -397,8 +397,24 @@ def main():
         obs.counter("soak.rounds").inc()
         if done % 25 == 0:
             print(f"soak: {done} rounds clean (seed {seed})", flush=True)
-    obs.event("soak.done", rounds=done, seed0=args.seed0,
-              last_seed=seed)
+    done_fields = dict(rounds=done, seed0=args.seed0, last_seed=seed)
+    if obs.enabled() and args.obs_out:
+        # the soak's cost-model aggregate (waves, dispatches, delta
+        # ops, slope verdict) rides the terminal event, computed from
+        # the SIDECAR FILE — the in-process ring is bounded (65536
+        # events) and a long soak overflows it, which would make this
+        # digest silently disagree with the ledger row's ``cost``
+        # extension (ingest_record scans the same file)
+        from cause_tpu.obs import load_jsonl
+        from cause_tpu.obs.costmodel import costmodel_digest
+
+        try:
+            cost = costmodel_digest(load_jsonl(args.obs_out))
+        except OSError:
+            cost = {}
+        if cost:
+            done_fields["cost"] = cost
+    obs.event("soak.done", **done_fields)
     obs.flush()
     _append_soak_ledger_row(args, done, seed)
     print(f"soak finished: {done} rounds clean, no failures", flush=True)

@@ -1,0 +1,47 @@
+"""Known-bad OBS005 fixture: wave cost-model APIs on a traced path.
+Only the unguarded calls gate — every OBS003/OBS004 guard spelling
+(nested if, costmodel.enabled, aliased import, early return,
+negated-test else) is sanctioned here too."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu.obs import costmodel
+from cause_tpu.obs import costmodel as _cm
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    costmodel.record_dispatch("wave:v5:u64")          # OBS005: unguarded
+    if obs.enabled():
+        costmodel.record_dispatch("wave:v5:u64")      # guarded: fine
+    if costmodel.enabled():
+        # the module's own guard spelling must not be flagged as an
+        # unguarded costmodel call itself
+        costmodel.note_delta_ops("u", 3)
+    if _obs_enabled():
+        # the aliased guard + aliased module spellings are fine
+        _cm.wave_begin("wave")
+    return x * 2
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    costmodel.wave_cost(uuid="u", pairs=1)
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful costmodel call), its ELSE branch is
+    # obs-on only (guarded: fine)
+    if not obs.enabled():
+        costmodel.note_full_bag("u")                  # OBS005
+    else:
+        costmodel.note_full_bag("u")                  # guarded: fine
+    return x

@@ -122,6 +122,22 @@ def test_semantic_unguarded_call_on_traced_path():
     assert rules_of(res) == ["OBS004"]
 
 
+def test_costmodel_unguarded_call_on_traced_path():
+    """OBS005 (PR-6): the wave cost model takes registry locks and
+    builds per-wave dispatch records when obs is on — jit-reachable
+    code must gate it behind obs.enabled(). Exactly two findings —
+    the plain unguarded call and the body of a negated test; every
+    OBS003/OBS004 guard spelling (nested if, costmodel.enabled,
+    aliased module, early return, else of a negated test) is
+    sanctioned."""
+    res = run_api(os.path.join(FIX, "costmodel_caller_bad.py"))
+    obs5 = [f for f in res.findings if f.rule == "OBS005"]
+    assert len(obs5) == 2, [f.message for f in obs5]
+    assert "record_dispatch" in obs5[0].message
+    assert "note_full_bag" in obs5[1].message
+    assert rules_of(res) == ["OBS005"]
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -235,7 +251,7 @@ def test_cli_exit_codes():
 @pytest.mark.parametrize("fixture", [
     "tid_bad.py", "jph_bad.py", os.path.join("obs", "obs_bad.py"),
     "obs_caller_bad.py", "devprof_caller_bad.py",
-    "semantic_caller_bad.py", "lca_bad.py",
+    "semantic_caller_bad.py", "costmodel_caller_bad.py", "lca_bad.py",
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -245,8 +261,8 @@ def test_cli_list_rules():
     out = run_cli("--list-rules")
     assert out.returncode == 0
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
-                "OBS001", "OBS002", "OBS003", "OBS004", "LCA001",
-                "GEN001"):
+                "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
+                "LCA001", "GEN001"):
         assert rid in out.stdout
 
 
