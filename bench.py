@@ -181,6 +181,247 @@ class _Overflow(RuntimeError):
     pass
 
 
+def _sweep_levels() -> list:
+    """Parse BENCH_DIV_SWEEP ("10,50,500,5000": per-pair total
+    divergence ops per level). Empty when the sweep mode is off."""
+    raw = os.environ.get("BENCH_DIV_SWEEP", "").strip()
+    if not raw:
+        return []
+    try:
+        levels = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        raise SystemExit(f"bench: BENCH_DIV_SWEEP must be a comma-"
+                         f"separated list of integers; got {raw!r}")
+    if any(d < 2 or d % 2 for d in levels):
+        # odd levels would silently measure d-1 ops (the generator
+        # splits the divergence across the pair's two sides) while
+        # every label claimed d — reject instead of mislabeling
+        raise SystemExit("bench: BENCH_DIV_SWEEP levels must be even "
+                         "and >= 2 (ops split across the pair's two "
+                         "sides)")
+    return levels
+
+
+def _divergence_sweep(real_platform: str, tag: str, smoke: bool,
+                      reps: int, bail, marshals, B: int, doc: int,
+                      cap: int) -> dict:
+    """The divergence sweep: at a FIXED document shape, one timed
+    burst per divergence level for BOTH wave generations — the
+    full-width v5 control and the delta-native window weave — each
+    emitting ``wave.cost`` with the generator's KNOWN divergence and
+    landing a ``--kind sweep`` ledger row. The sidecar then renders
+    through ``python -m cause_tpu.obs gap`` as TWO cost-vs-divergence
+    curves (path "full" vs path "delta") instead of a single-point
+    slope, and per-level digest equality (full == prefix + window,
+    bit-identical uint32) gates that level's evidence: a disagreeing
+    level's timings never land as ledger rows.
+
+    ``marshals`` is the pre-claim ``[(level, delta_sweep_inputs), …]``
+    list — measure() builds it BEFORE the backend claim so the tens of
+    seconds of host numpy per level never spend granted tunnel time
+    (the same window-economy rule as the headline path)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver import jaxwd
+    from cause_tpu.weaver.arrays import next_pow2
+
+    N_BURST = int(os.environ.get("BENCH_BURST", "8"))
+    rows = []
+    agree_all = True
+    for d, sw in marshals:
+        n_div = d // 2
+        bail()
+        u_need = int(benchgen.v5_token_budget(sw["full"]))
+        u_full = next_pow2(u_need)
+        n_w = 2 * sw["wcap"]
+        with obs.span("bench.sweep.upload", level=d):
+            dev_full = [jax.device_put(sw["full"][k])
+                        for k in LANE_KEYS5]
+            dev_win = [jax.device_put(sw["window"][k])
+                       for k in LANE_KEYS5]
+            pdig = jax.device_put(sw["prefix_digest"])
+            r0 = jax.device_put(sw["r0"])
+            starts = jax.device_put(sw["starts"])
+            counts = jax.device_put(sw["counts"])
+
+        def full_dispatch():
+            rank, vis, dig, ovf = jaxwd.batched_weave_digest(
+                *dev_full, u_max=int(u_full), k_max=int(u_full))
+            if obs.enabled():
+                from cause_tpu.obs import costmodel as _cm
+
+                _cm.record_dispatch(f"sweep:full:u{int(u_full)}",
+                                    site="bench")
+            return rank, vis, dig, ovf
+
+        def _begin():
+            """Open the cost-model wave window for one timed single
+            (the bracket benchgen.time_dispatch applies per rep)."""
+            if obs.enabled():
+                from cause_tpu.obs import costmodel as _cm
+
+                _cm.wave_begin("bench")
+
+        def _end(path, tokens, budget):
+            def close():
+                if obs.enabled():
+                    from cause_tpu.obs import costmodel as _cm
+
+                    _cm.wave_cost(uuid=f"bench:sweep:{d}", pairs=B,
+                                  lanes=2 * cap * B, tokens=tokens,
+                                  token_budget=budget,
+                                  delta_ops=2 * n_div * B, path=path)
+            return close
+
+        # ---- full-weave control -----------------------------------
+        with obs.span("bench.sweep.full_compile", level=d):
+            keep = full_dispatch()
+            full_dig = np.asarray(keep[2])
+            if np.asarray(keep[3]).any():
+                raise RuntimeError(f"sweep level {d}: full control "
+                                   "overflowed its token budget")
+        bail()
+        full_singles, full_bursts = benchgen.time_dispatch(
+            lambda: full_dispatch()[2], reps, N_BURST, begin=_begin,
+            end=_end("full", u_need * B, int(u_full) * B))
+        full_p50 = float(np.median(full_singles))
+        full_amortized = float(np.median(full_bursts))
+
+        # ---- delta-native arm -------------------------------------
+        # residents: the control's own converged ranks/visibility (the
+        # state a session would hold); re-donated through every splice
+        res_rank = jnp.asarray(np.asarray(keep[0]))
+        res_vis = jnp.asarray(np.asarray(keep[1]))
+
+        def delta_dispatch():
+            nonlocal res_rank, res_vis
+            rw, vw, dig, ovf = jaxwd.batched_delta_weave(
+                *dev_win, pdig, r0, u_max=int(n_w), k_max=int(n_w))
+            res_rank, res_vis = jaxwd.splice_ranks(
+                res_rank, res_vis, rw, vw, starts, counts, r0)
+            if obs.enabled():
+                from cause_tpu.obs import costmodel as _cm
+
+                _cm.record_dispatch(f"sweep:delta:w{sw['wcap']}",
+                                    site="bench")
+                _cm.record_dispatch("sweep:delta_splice",
+                                    site="bench")
+            return rw, vw, dig, ovf
+
+        def delta_sync():
+            """The timed delta wave's sync value: the digest
+            CONCATENATED with one spliced-rank column, so the fetch
+            has a data dependency on BOTH programs — syncing on the
+            digest alone would let the O(doc) splice scatter run past
+            the timer and understate the delta arm."""
+            _rw, _vw, dig, _ovf = delta_dispatch()
+            return jnp.concatenate(
+                [dig, res_rank[:, 0].astype(jnp.uint32)])
+
+        with obs.span("bench.sweep.delta_compile", level=d):
+            _, _, delta_dig, ovw = delta_dispatch()
+            delta_dig = np.asarray(delta_dig)
+            if np.asarray(ovw).any():
+                raise RuntimeError(f"sweep level {d}: delta window "
+                                   "overflowed (u_max = N_w should "
+                                   "make this impossible)")
+        delta_singles, delta_bursts = benchgen.time_dispatch(
+            delta_sync, reps, N_BURST, begin=_begin,
+            end=_end("delta", 2 * (n_div + 1) * B, int(n_w) * B))
+        delta_p50 = float(np.median(delta_singles))
+        delta_amortized = float(np.median(delta_bursts))
+
+        # ---- the convergence gate ---------------------------------
+        agreed = bool(np.array_equal(full_dig, delta_dig))
+        agree_all = agree_all and agreed
+        if obs.enabled():
+            from cause_tpu.obs import semantic as _sem
+
+            # the two wave generations as two replicas of one
+            # document: their per-path digest folds agree iff every
+            # row's digests are bit-identical (the exact np compare
+            # gates; the fold is the wave.digest evidence trail)
+            folds = [int(np.bitwise_xor.reduce(
+                x ^ (np.arange(B, dtype=np.uint32) * np.uint32(
+                    0x9E3779B1)))) for x in (full_dig, delta_dig)]
+            if not agreed and folds[0] == folds[1]:
+                folds[1] ^= 1  # never mask a real mismatch
+            _sem.observe_wave(f"bench:sweep:{d}", folds, [True, True],
+                              source="bench-delta-gate")
+        level_row = {
+            "level_ops": d, "n_div_side": n_div, "doc": doc + 1,
+            "pairs": B, "wcap": sw["wcap"],
+            "full_p50_ms": round(full_p50, 3),
+            "full_amortized_ms": round(full_amortized, 3),
+            "delta_p50_ms": round(delta_p50, 3),
+            "delta_amortized_ms": round(delta_amortized, 3),
+            "delta_over_full": round(delta_amortized /
+                                     max(full_amortized, 1e-9), 4),
+            "digest_agreed": agreed,
+        }
+        rows.append(level_row)
+        print(f"bench: sweep level {d}: full {full_amortized:.1f} ms "
+              f"vs delta {delta_amortized:.1f} ms amortized "
+              f"({100 * level_row['delta_over_full']:.1f}%), digests "
+              + ("AGREE" if agreed else "DISAGREE"), file=sys.stderr)
+        if not agreed:
+            # a disagreeing level means the delta generation is WRONG
+            # at this shape — its timings are not evidence and must
+            # never land next to certified rows (the summary line and
+            # the wave.digest divergence event carry the incident)
+            print(f"bench: sweep level {d}: digests DISAGREE — "
+                  "skipping this level's ledger rows", file=sys.stderr)
+        else:
+            # one --kind sweep ledger row per (level, path): the
+            # sweep's evidence of record, partitioned away from the
+            # headline bench rows (kind != "bench" never headlines).
+            # Deliberately NOT behind obs.enabled(): the rows are the
+            # point of the run; obs only adds the sidecar digests.
+            try:
+                from cause_tpu.obs import ledger
+
+                for path_name, val, single in (
+                        ("full", full_amortized, full_p50),
+                        ("delta", delta_amortized, delta_p50)):
+                    ledger.ingest_record(
+                        {"platform": tag or real_platform,
+                         "metric": f"divergence sweep {path_name} "
+                                   f"wave, {B}x{doc + 1} nodes, "
+                                   f"{d}-op divergence",
+                         "value": round(val, 3),
+                         "single_dispatch_ms": round(single, 3),
+                         "kernel": ("v5" if path_name == "full"
+                                    else "v5d"),
+                         "config": f"div{d}-{path_name}",
+                         "schema_version": BENCH_SCHEMA_VERSION},
+                        source=f"bench-sweep@{time.strftime('%Y-%m-%d')}",
+                        kind="sweep",
+                        extra={"digest_agreed": True})
+            except Exception as e:  # noqa: BLE001 - best-effort rows
+                print(f"bench: sweep ledger append failed ({e})",
+                      file=sys.stderr)
+        # free this level's device buffers before the next marshal
+        del dev_full, dev_win, keep, res_rank, res_vis
+    obs.flush()
+    return {
+        "metric": f"divergence sweep (delta-native vs full weave), "
+                  f"{B} replica pairs x {doc + 1}-node CausalLists"
+                  + (" [smoke size]" if smoke else ""),
+        "value": None,
+        "unit": "ms",
+        "levels": rows,
+        "digest_agreed": agree_all,
+        "vs_baseline": 0.0,
+        "platform": tag or real_platform,
+        "schema_version": BENCH_SCHEMA_VERSION,
+    }
+
+
 def _timed_once(step, k_max, kernel) -> float:
     t0 = time.perf_counter()
     step(k_max, kernel)
@@ -245,6 +486,50 @@ def measure(platform: str) -> dict:
     # performs the blocking claim — so it must come after the marshal
     # too, not just before devices().
     smoke = _flag("BENCH_SMOKE")
+    sweep = _sweep_levels()
+    if sweep:
+        # divergence sweep mode: per-level marshals replace the single
+        # headline marshal. ALL levels marshal here, before the
+        # backend claim (window economy — tens of seconds of host
+        # numpy per level must not spend granted tunnel time), which
+        # also validates every level against the document shape before
+        # any timed work is spent.
+        if smoke:
+            sw_B, sw_doc, sw_cap = 8, 1_000, 1_024
+        else:
+            sw_B, sw_doc, sw_cap = 1024, 10_000, 10_240
+        bad = [d for d in sweep if d // 2 >= sw_doc]
+        if bad:
+            raise SystemExit(f"bench: sweep level(s) {bad} exceed "
+                             f"the {sw_doc}-node document shape")
+        from cause_tpu import benchgen
+
+        marshals = []
+        for d in sweep:
+            with obs.span("bench.sweep.marshal", level=d, B=sw_B):
+                marshals.append((d, benchgen.delta_sweep_inputs(
+                    sw_B, sw_doc - d // 2, d // 2, sw_cap,
+                    hide_every=8)))
+        if platform != "cpu":
+            enable_compile_cache()
+        real_platform = jax.devices()[0].platform
+        obs.set_platform(real_platform)
+        sentinel = os.environ.get("BENCH_SENTINEL")
+        if sentinel:
+            with open(sentinel, "w") as f:
+                f.write(real_platform)
+
+        def _bail():
+            if sentinel and os.path.exists(sentinel + ".abandoned"):
+                print("bench child: parent abandoned this attempt; "
+                      "exiting", file=sys.stderr)
+                raise SystemExit(4)
+
+        tag = os.environ.get("BENCH_TAG") or real_platform
+        return _divergence_sweep(real_platform, tag, smoke,
+                                 reps=3, bail=_bail,
+                                 marshals=marshals, B=sw_B,
+                                 doc=sw_doc, cap=sw_cap)
     if smoke:
         B, n_base, n_div, cap, reps = 8, 800, 100, 1024, 3
     else:
@@ -340,7 +625,7 @@ def measure(platform: str) -> dict:
                 uuid="bench", pairs=B, lanes=2 * cap * B,
                 tokens=k * B if v5_family else None,
                 token_budget=k * B if v5_family else 0,
-                delta_ops=2 * n_div * B)
+                delta_ops=2 * n_div * B, path="full")
 
     N_BURST = int(os.environ.get("BENCH_BURST", "8"))
 
@@ -700,6 +985,13 @@ def main() -> None:
             line = out.splitlines()[-1]
             print(line)
             _export_obs_trace(obs_out)
+            if _sweep_levels():
+                # the sweep child already landed one --kind sweep row
+                # per (level, path); ingesting the summary line as a
+                # bench row would plant a value-less bench artifact
+                # next to the headline trajectory
+                _print_gap_report(obs_out)
+                return
             _append_to_ledger(line, obs_out)
             _print_gap_report(obs_out)
             return

@@ -32,16 +32,19 @@ from .weaver.arrays import (
     I32_MAX,
     PackSpec,
     VCLASS_HIDE,
+    next_pow2,
 )
 
 __all__ = [
     "chain_tree_lanes",
     "divergent_pair_lanes",
     "batched_pair_lanes",
+    "delta_sweep_inputs",
     "fleet_lanes",
     "estimate_pair_runs",
     "pair_run_budget",
     "merge_wave_scalar",
+    "time_dispatch",
     "enable_compile_cache",
     "v5_inputs",
     "batched_v5_inputs",
@@ -164,6 +167,43 @@ def enable_compile_cache(path: Optional[str] = None) -> None:
         )
     except Exception:  # pragma: no cover - older jax
         pass
+
+
+def time_dispatch(dispatch, reps: int, burst_n: int = 8,
+                  begin=None, end=None):
+    """bench.py's timing methodology as ONE helper, so every new
+    measurement arm is methodology-identical by construction instead
+    of a hand-copied loop: ``reps`` timed single dispatches (each
+    synced by fetching the dispatch's return — the only reliable sync
+    on the axon tunnel), then amortized ``burst_n``-wave bursts with
+    ONE terminal sync — ``reps`` of them while the single p50 is
+    under a second, one otherwise (at that point the dispatch floor
+    is noise and repeated bursts only burn window time). ``begin``/
+    ``end`` bracket each timed single (the cost-model wave window);
+    bursts are deliberately un-bracketed — a burst is not one wave.
+    Returns ``(singles_ms, bursts_ms)``."""
+    import time as _time
+
+    singles = []
+    for _ in range(reps):
+        if begin is not None:
+            begin()
+        t0 = _time.perf_counter()
+        np.asarray(dispatch())
+        ms = (_time.perf_counter() - t0) * 1000.0
+        singles.append(ms)
+        if end is not None:
+            end()
+    bursts = []
+    burst_reps = (reps if float(np.median(singles)) < 1000.0 else 1)
+    for _ in range(burst_reps):
+        t0 = _time.perf_counter()
+        out = None
+        for _ in range(burst_n):
+            out = dispatch()
+        np.asarray(out)
+        bursts.append((_time.perf_counter() - t0) * 1000.0 / burst_n)
+    return singles, bursts
 
 
 _scalar_programs: Dict = {}
@@ -618,6 +658,118 @@ def fleet_lanes(
         ).astype(np.int32)
         rows.append(row)
     return {k: np.concatenate([row[k] for row in rows]) for k in rows[0]}
+
+
+def delta_sweep_inputs(
+    n_replicas: int,
+    n_base: int,
+    n_div: int,
+    capacity: int,
+    hide_every: int = 0,
+    spec: PackSpec = DEFAULT_PACK,
+    include_full: bool = True,
+) -> dict:
+    """Paired full-weave / delta-weave inputs for the divergence sweep
+    (BENCH_DIV_SWEEP) and the harvest delta items: the same synthetic
+    workload expressed both as the document-width batch the full v5
+    kernel dispatches and as the delta-native WINDOW batch
+    (``weaver.jaxwd.batched_delta_weave``'s inputs) plus the frozen
+    prefix state a resident session would hold.
+
+    The workload is ``batched_pair_lanes`` restricted to the delta
+    domain: the first divergent node on each side is never a tombstone
+    (a tombstone whose cause is the shared base tail — the anchor —
+    would flip a frozen resident lane's visibility, which is exactly
+    the case the session falls back to a full wave for; see
+    ``parallel.wave.delta_domain_ok``). Everything else — per-row
+    suffix sites, per-row tombstone phases deeper in the suffix — is
+    the headline generator's shape, so the A/B compares the same
+    steady-state editing pattern.
+
+    Returns a dict: ``full`` (``LANE_KEYS5`` arrays, [B, 2*capacity]),
+    ``window`` (``LANE_KEYS5`` arrays, [B, 2*wcap] with
+    ``wcap = next_pow2(1 + n_div)``), ``r0`` ([B] int32 anchor ranks =
+    ``n_base``), ``prefix_digest`` ([B] uint32 — the resident prefix's
+    frozen avalanche sum, host-computed with the ``mesh.mix32_np``
+    twin), ``wcap`` and ``starts``/``counts`` ([B, 2] — the splice
+    program's coordinates). Digest identity — full-kernel digest ==
+    ``prefix_digest`` + window contribution — is the delta gate both
+    consumers check on-device.
+
+    ``include_full=False`` skips the full-width v5 marshal (the
+    per-row segment extraction is the expensive half at 1024x10k):
+    timing-only delta consumers (harvest's bench_delta items) need
+    just the window arm.
+    """
+    batch = batched_pair_lanes(
+        n_replicas=n_replicas, n_base=n_base, n_div=n_div,
+        capacity=capacity, hide_every=hide_every, spec=spec,
+    )
+    # delta-domain restriction: no tombstone on the first suffix node
+    # of either side (its cause is the anchor)
+    if n_div > 0:
+        batch["vc"][:, 1 + n_base] = 0
+        batch["vc"][:, capacity + 1 + n_base] = 0
+    full = batched_v5_inputs(batch, capacity) if include_full else None
+
+    wcap = next_pow2(max(8, 1 + n_div))
+    B = n_replicas
+    n_w = 2 * wcap
+    window = {
+        "hi": np.full((B, n_w), I32_MAX, np.int32),
+        "lo": np.full((B, n_w), I32_MAX, np.int32),
+        "cci": np.full((B, n_w), -1, np.int32),
+        "vc": np.zeros((B, n_w), np.int32),
+        "valid": np.zeros((B, n_w), bool),
+    }
+    sfx = {0: slice(1 + n_base, 1 + n_base + n_div),
+           1: slice(capacity + 1 + n_base,
+                    capacity + 1 + n_base + n_div)}
+    anchor_hi = np.int32(n_base)
+    anchor_lo = np.int32(SITE_BASE << spec.tx_bits)
+    for t in range(2):
+        off = t * wcap
+        window["hi"][:, off] = anchor_hi
+        window["lo"][:, off] = anchor_lo
+        window["valid"][:, off] = True
+        if n_div:
+            w = 1 + n_div
+            window["hi"][:, off + 1:off + w] = batch["hi"][:, sfx[t]]
+            window["lo"][:, off + 1:off + w] = batch["lo"][:, sfx[t]]
+            window["vc"][:, off + 1:off + w] = batch["vc"][:, sfx[t]]
+            window["valid"][:, off + 1:off + w] = True
+            # suffix causes are a pure chain off the anchor: window
+            # lane j's cause is lane j-1 (the anchor at j=1)
+            window["cci"][:, off + 1:off + w] = off + np.arange(
+                n_div, dtype=np.int32)
+    window = batched_v5_inputs(
+        {k: window[k] for k in LANE_KEYS4}, wcap)
+
+    # the frozen prefix: root + base chain, ranks 0..n_base (the weave
+    # IS the chain), root invisible, chain visible — identical for
+    # every row, so one host sum serves the whole batch
+    from .parallel.mesh import mix32_np
+
+    p_hi = np.arange(n_base + 1, dtype=np.int32)
+    p_lo = np.full(n_base + 1, np.int32(SITE_BASE << spec.tx_bits))
+    p_lo[0] = 0  # the root's site rank is 0
+    p_rank = np.arange(n_base + 1, dtype=np.int32)
+    p_vis = np.ones(n_base + 1, bool)
+    p_vis[0] = False
+    pdig = np.uint32(
+        mix32_np(p_hi, p_lo, p_rank, p_vis).sum(dtype=np.uint64)
+        & np.uint64(0xFFFFFFFF))
+    starts = np.full((B, 2), n_base + 1, np.int32)
+    counts = np.full((B, 2), n_div, np.int32)
+    return {
+        "full": full,
+        "window": window,
+        "wcap": int(wcap),
+        "r0": np.full(B, n_base, np.int32),
+        "prefix_digest": np.full(B, pdig, np.uint32),
+        "starts": starts,
+        "counts": counts,
+    }
 
 
 def batched_pair_lanes(

@@ -203,7 +203,8 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
               tokens: Optional[int] = None, token_budget: int = 0,
               delta_ops: int = 0, full_bag: int = 0,
               poisoned: int = 0, overflow_retries: int = 0,
-              semantic: Optional[dict] = None) -> Optional[dict]:
+              semantic: Optional[dict] = None,
+              path: str = "") -> Optional[dict]:
     """Close the open wave window and emit ONE ``wave.cost`` event —
     the per-wave join of cost and divergence:
 
@@ -220,7 +221,12 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
       and the wave's semantic summary (``wave.digest`` fields) when
       given;
     - scale: ``pairs`` and ``lanes`` (the O(doc) axis the divergence
-      fields are judged against).
+      fields are judged against);
+    - ``path``: which wave generation ran — ``"full"`` (document-width
+      kernel) or ``"delta"`` (the delta-native window weave). The gap
+      report fits a separate cost-vs-divergence curve per path, so a
+      sweep stream renders the O(doc) control verdict NEXT TO the
+      delta path's O(delta) verdict instead of mixing them.
 
     Returns the emitted fields (or None when obs is off / no window).
     """
@@ -256,6 +262,8 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
         "floor_ms": DISPATCH_FLOOR_MS,
         "floor_budget_ms": round(DISPATCH_FLOOR_MS * dispatches, 3),
     }
+    if path:
+        fields["path"] = str(path)
     if tokens is not None:
         fields["tokens"] = int(tokens)
         fields["token_budget"] = int(token_budget)
@@ -506,6 +514,20 @@ def gap_report(rows: Sequence[dict],
         report["stages"] = stages
     curve = cost_vs_divergence(waves)
     report["cost_vs_divergence"] = curve
+    # per-path curves: when the stream carries waves from more than
+    # one generation ("delta" vs "full", else the emitting source),
+    # each gets its own slope verdict — the delta-native acceptance
+    # gate is "O(delta) for the delta path AND O(doc) for the
+    # full-weave control", which one pooled fit cannot express
+    groups: Dict[str, List[dict]] = {}
+    for f in waves:
+        groups.setdefault(
+            str(f.get("path") or f.get("source") or "?"), []
+        ).append(f)
+    if len(groups) > 1:
+        report["cost_vs_divergence_by_path"] = {
+            k: cost_vs_divergence(v) for k, v in sorted(groups.items())
+        }
     # projection: if wave cost scaled with the measured divergence
     # (the delta-native weave's promise), the headline would shrink to
     # its divergence fraction — floored by the dispatch floor, which
@@ -561,18 +583,24 @@ def render_gap(report: dict) -> str:
     for st in report.get("stages", []):
         lines.append(f"  phase {st['stage']}: {st['delta_ms']:g} ms "
                      f"({100 * st['share']:.1f}%)")
-    c = report.get("cost_vs_divergence") or {}
-    if c.get("verdict") == "insufficient-data":
-        lines.append(f"  cost vs divergence: insufficient data "
-                     f"({c.get('points', 0)} wave(s) in the stream)")
-    elif c:
-        lines.append(
-            f"  cost vs divergence: {c['points']} waves, divergence "
+    def _curve_line(c, label="cost vs divergence"):
+        if c.get("verdict") == "insufficient-data":
+            return (f"  {label}: insufficient data "
+                    f"({c.get('points', 0)} wave(s) in the stream)")
+        return (
+            f"  {label}: {c['points']} waves, divergence "
             f"{c['divergence_min']:g}-{c['divergence_max']:g} ops, "
             f"slope {c['slope_ms_per_op']:g} ms/op "
             f"(corr {c['corr']:g}, explains "
             f"{100 * c['explained_ratio']:.0f}% of spread) -> "
             f"verdict: {c['verdict']}")
+
+    c = report.get("cost_vs_divergence") or {}
+    if c:
+        lines.append(_curve_line(c))
+    for name, cp in sorted(
+            (report.get("cost_vs_divergence_by_path") or {}).items()):
+        lines.append(_curve_line(cp, label=f"path {name}"))
     proj = report.get("projected")
     if proj:
         lines.append(

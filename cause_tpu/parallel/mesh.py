@@ -40,6 +40,8 @@ except ImportError:  # pragma: no cover
 __all__ = [
     "REPLICA_AXIS",
     "make_mesh",
+    "mix32",
+    "mix32_np",
     "replica_digest",
     "sharded_merge_weave",
     "sharded_merge_weave_v4",
@@ -79,10 +81,42 @@ def replica_digest(hi_sorted, lo_sorted, rank, visible):
     m = rank.shape[0]
     kept = rank < m
     pos = jnp.where(kept, rank.astype(jnp.uint32), jnp.uint32(0))
+    x = mix32(hi_sorted, lo_sorted, pos, visible)
+    return jnp.sum(jnp.where(kept, x, jnp.uint32(0)))
+
+
+def mix32_np(hi, lo, pos, visible):
+    """Numpy twin of ``mix32``'s per-lane avalanche term — returns the
+    uint32 term array (callers sum the kept lanes). The delta-native
+    weave uses it to freeze a resident prefix's digest contribution
+    host-side, so the arithmetic here MUST stay bit-identical to
+    ``mix32`` below; tests/test_delta_weave.py pins the pair against
+    each other and against ``replica_digest`` end to end."""
     x = (
-        hi_sorted.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
-        + lo_sorted.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
-        + pos * jnp.uint32(0xC2B2AE35)
+        hi.astype(np.uint32) * np.uint32(0x9E3779B1)
+        + lo.astype(np.uint32) * np.uint32(0x85EBCA77)
+        + pos.astype(np.uint32) * np.uint32(0xC2B2AE35)
+        + visible.astype(np.uint32) * np.uint32(40503)
+        + np.uint32(1)
+    )
+    x = x ^ (x >> np.uint32(16))
+    x = x * np.uint32(0x85EBCA6B)
+    x = x ^ (x >> np.uint32(13))
+    x = x * np.uint32(0xC2B2AE35)
+    x = x ^ (x >> np.uint32(16))
+    return x
+
+
+def mix32(hi, lo, pos, visible):
+    """The per-lane murmur3-style avalanche term of the convergence
+    digest — the ONE traced copy: ``replica_digest`` sums it over a
+    replica's kept lanes, and the delta wave
+    (``weaver.jaxwd.batched_delta_weave``) sums it over window lanes
+    at offset positions. ``mix32_np`` above is its numpy twin."""
+    x = (
+        hi.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+        + lo.astype(jnp.uint32) * jnp.uint32(0x85EBCA77)
+        + pos.astype(jnp.uint32) * jnp.uint32(0xC2B2AE35)
         + visible.astype(jnp.uint32) * jnp.uint32(40503)
         + jnp.uint32(1)
     )
@@ -91,7 +125,7 @@ def replica_digest(hi_sorted, lo_sorted, rank, visible):
     x = x ^ (x >> 13)
     x = x * jnp.uint32(0xC2B2AE35)
     x = x ^ (x >> 16)
-    return jnp.sum(jnp.where(kept, x, jnp.uint32(0)))
+    return x
 
 
 def _fleet_reductions(axis, hi, lo, rank, visible, conflict, overflow):

@@ -18,12 +18,25 @@ and ships only what changed:
 
 A tree whose cache dropped (mid-order insert, weft) or whose delta
 exceeds the budget falls back to a full re-upload of the whole batch
-that wave — correct, just slower. ``wave()`` then runs the v5 kernel
-over the resident lanes and fetches ONE small digest array; ranks and
-visibility stay device-resident for on-demand materialization.
+that wave — correct, just slower. ``wave()`` then converges the fleet
+and fetches ONE small digest array; ranks and visibility stay
+device-resident for on-demand materialization.
+
+**Delta-native waves (PR 7).** Residency alone still paid a full
+-document-width KERNEL per wave. After any full-width wave the session
+freezes a per-pair *delta frontier* — the shared converged lane
+prefix, its weave-final node (the anchor every divergent subtree
+attaches under), and the prefix's exact uint32 digest contribution —
+and steady-state waves dispatch ``weaver.jaxwd.batched_delta_weave``
+over just the divergent WINDOW (anchor + suffix lanes), splicing
+ranks/visibility back into the resident weave and returning digests
+bit-identical to the full wave's. Device work per wave is then
+O(divergence); first contact, domain violations
+(``wave.delta_domain_ok``), window-budget overflow, and every
+update-level fallback run the full kernel and re-establish.
 
 This is the TPU-native sync-fleet loop: edit replicas on host, ship
-deltas, converge on device, read digests.
+deltas, weave ONLY the deltas on device, read digests.
 """
 
 from __future__ import annotations
@@ -42,7 +55,8 @@ from ..weaver import lanecache
 from ..weaver.arrays import next_pow2
 from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
 from .wave import (WaveBuffers, _PAD, _assemble_rows, _digest_fn,
-                   _observe_semantics, _sampled_body_spotcheck)
+                   _observe_semantics, _sampled_body_spotcheck,
+                   assemble_delta_window, delta_domain_ok)
 
 __all__ = ["FleetSession"]
 
@@ -104,7 +118,8 @@ class FleetSession:
     """
 
     def __init__(self, pairs: Sequence[Tuple[object, object]],
-                 d_max: int = 256, u_headroom: float = 2.0):
+                 d_max: int = 256, u_headroom: float = 2.0,
+                 delta: bool = True):
         pairs = list(pairs)
         if not pairs:
             raise s.CausalError("Nothing to merge.",
@@ -128,7 +143,19 @@ class FleetSession:
         # wave.cost event carries it as divergence evidence
         self._last_delta_lanes = 0
         self._last_update_full = False
+        # delta-native wave state, established after each full wave
+        # (see _establish_delta): None = next wave runs full width.
+        # ``delta=False`` pins the session to full-width waves (the
+        # A/B control and the escape hatch). Establishment costs an
+        # O(doc) rank fetch, so repeated failures (a fleet whose edits
+        # keep violating the delta domain) back off permanently after
+        # _DELTA_FAILURE_LIMIT consecutive misses.
+        self._delta_enabled = bool(delta)
+        self._delta = None
+        self._delta_failures = 0
         self._full_upload(pairs)
+
+    _DELTA_FAILURE_LIMIT = 3
 
     # ------------------------------------------------------------------
     def _collect_views(self, pairs):
@@ -198,9 +225,12 @@ class FleetSession:
         self._gen = views[0][0].interner.generation
         self.pairs = list(pairs)
         # a full upload is the session's O(doc) degradation: the next
-        # wave.cost records it as a full-bag wave with zero delta ops
+        # wave.cost records it as a full-bag wave with zero delta ops,
+        # and the delta-wave capability drops until the next full wave
+        # re-establishes the resident frontier
         self._last_delta_lanes = 0
         self._last_update_full = True
+        self._delta = None
         if obs.enabled():
             from ..obs import devprof
 
@@ -317,6 +347,34 @@ class FleetSession:
             for k in SEG_LANE_KEYS:
                 tables[k].append(row[k])
 
+        if self._delta is not None:
+            # delta-WAVE domain (stricter than the lane-splice domain
+            # above): every appended lane must weave strictly after the
+            # frozen resident prefix — causes inside the divergent
+            # window or on the anchor, no tombstone of the anchor, and
+            # the window must fit the session's compiled budget. A
+            # violation only drops the delta-wave capability (the next
+            # wave runs full width and re-establishes); the resident
+            # lane splice above stays valid either way.
+            dstate = self._delta
+            w_cap = dstate["w_cap"]
+            for r, (va, vb) in enumerate(views):
+                sp = int(dstate["s"][r])
+                anchor = int(dstate["anchor"][r])
+                ok = True
+                for t, v in enumerate((va, vb)):
+                    if v.n - sp > w_cap - 1:
+                        ok = False  # window outgrew the budget
+                        break
+                    if not delta_domain_ok(v, sp, anchor,
+                                           start=int(starts[r, t])):
+                        ok = False
+                        break
+                if not ok:
+                    obs.counter("session.delta_wave_invalidate").inc()
+                    self._delta = None
+                    break
+
         self.dev = _apply_deltas(
             self.dev,
             {c: jnp.asarray(deltas[c]) for c in _LANE_COLS},
@@ -341,9 +399,27 @@ class FleetSession:
 
     # ------------------------------------------------------------------
     def wave(self):
-        """One merge wave over the resident lanes. Returns the [B]
+        """One merge wave over the resident state. Returns the [B]
         digest array (fetched); rank/visible stay on device as
-        ``self.last_rank`` / ``self.last_visible``."""
+        ``self.last_rank`` / ``self.last_visible``.
+
+        Routing: when a delta frontier is established (a full wave ran
+        and every divergent lane since stays inside the delta domain),
+        the wave dispatches only the divergent window and splices the
+        result into the resident weave — O(divergence) device work.
+        First contact, domain violations, window-budget overflow, and
+        every update()-level fallback run the full-width kernel
+        instead, and a full wave re-establishes the frontier."""
+        if self._delta is not None:
+            out = self._delta_wave()
+            if out is not None:
+                return out
+        return self._full_wave()
+
+    def _full_wave(self):
+        """The full-width wave (first contact / fallback path): v5
+        kernel + digest over the whole resident batch, then (re-)
+        establish the delta frontier from its ranks."""
         from ..benchgen import LANE_KEYS5
         from ..weaver.jaxw5 import batched_merge_weave_v5
 
@@ -411,6 +487,171 @@ class FleetSession:
                 delta_ops=self._last_delta_lanes,
                 full_bag=1 if self._last_update_full else 0,
                 semantic=sem,
+                path="full",
+            )
+            self._last_delta_lanes = 0
+            self._last_update_full = False
+        if self._delta_enabled:
+            self._establish_delta(r, v)
+        return out
+
+    # ----------------------------------------------- delta-native wave
+    def _fail_establish(self) -> None:
+        self._delta_failures += 1
+        obs.counter("session.delta_establish_fail").inc()
+
+    def _establish_delta(self, rank_dev, vis_dev) -> None:
+        """Derive the delta frontier from a completed full wave: the
+        shared converged lane prefix per pair, the anchor (the prefix
+        weave's final node — where every divergent subtree attaches),
+        the frozen prefix digest contribution, and the pow2 window
+        budget. Any pair outside the domain disables the delta path
+        until the next full wave (correct, just O(doc)).
+
+        Cost discipline: the shared-prefix precheck is host-only; the
+        O(doc) device rank fetch happens only after it passes, and the
+        visibility fetch only after every pair's rank/domain checks
+        pass. _DELTA_FAILURE_LIMIT consecutive failed establishments
+        stop further attempts for this session — a fleet whose edits
+        keep violating the domain must not pay the fetch per wave."""
+        from ..weaver.arrays import next_pow2 as _np2
+        from .mesh import mix32_np
+
+        self._delta = None
+        if self._delta_failures >= self._DELTA_FAILURE_LIMIT:
+            return
+        B = len(self.pairs)
+        cap = self.capacity
+        N = 2 * cap
+        s_arr = np.zeros(B, np.int32)
+        anchor_arr = np.zeros(B, np.int32)
+        pdig = np.zeros(B, np.uint32)
+        w_now = 0
+        for r, (va, vb) in enumerate(self._views):
+            sp = lanecache.shared_prefix_len(va, vb)
+            if sp < 1:
+                return self._fail_establish()
+            s_arr[r] = sp
+        rank_np = np.asarray(rank_dev)
+        for r, (va, vb) in enumerate(self._views):
+            sp = int(s_arr[r])
+            ra = rank_np[r, :sp]
+            rb = rank_np[r, cap:cap + sp]
+            pr = np.minimum(ra, rb)  # the kept copy's rank per node
+            # the prefix must BE the weave's prefix: its ranks are
+            # exactly {0..sp-1}, once each — anything else means some
+            # divergent lane wove inside it and nothing can be frozen
+            if not bool((pr < sp).all()):
+                return self._fail_establish()
+            if int(pr.max()) != sp - 1 or \
+                    int(np.bincount(pr, minlength=sp).max()) != 1:
+                return self._fail_establish()
+            anchor = int(np.argmax(pr))
+            arena = va.arena
+            if int(arena.vclass[anchor]) > 0:
+                # a special anchor breaks the host-jump locality
+                return self._fail_establish()
+            if not (delta_domain_ok(va, sp, anchor)
+                    and delta_domain_ok(vb, sp, anchor)):
+                return self._fail_establish()
+            anchor_arr[r] = anchor
+            w_now = max(w_now, va.n - sp, vb.n - sp)
+        vis_np = np.asarray(vis_dev)
+        for r, (va, _vb) in enumerate(self._views):
+            sp = int(s_arr[r])
+            arena = va.arena
+            ra = rank_np[r, :sp]
+            pr = np.minimum(ra, rank_np[r, cap:cap + sp])
+            keep_a = ra < N
+            vis = np.where(keep_a, vis_np[r, :sp],
+                           vis_np[r, cap:cap + sp])
+            hi = arena.ts[:sp].astype(np.int32)
+            lo = arena.spec.pack_lo(arena.site[:sp], arena.tx[:sp])
+            pdig[r] = np.uint32(
+                mix32_np(hi, lo, pr.astype(np.int32), vis)
+                .sum(dtype=np.uint64) & np.uint64(0xFFFFFFFF))
+        self._delta_failures = 0
+        self._delta = {
+            "s": s_arr,
+            "anchor": anchor_arr,
+            "prefix_digest": pdig,
+            # window budget: room for the current divergence plus one
+            # round's worth of appends, pow2-quantized so the window
+            # program's shape survives steady-state growth; outgrowing
+            # it falls back to a full wave, which re-establishes with
+            # the next bucket (the "budget overflow" rebuild policy)
+            "w_cap": int(_np2(max(8, w_now + 1 + self.d_max))),
+        }
+
+    def _delta_wave(self):
+        """The steady-state wave: weave the divergent window only,
+        splice ranks/visibility into the resident weave, and return
+        digests that are bit-identical to the full wave's. Returns
+        None when the dispatch overflowed (never, under the
+        ``u_max = N_w`` budget rule — a safety net, not a path): the
+        caller then runs the full-width wave."""
+        from ..benchgen import LANE_KEYS5
+        from ..weaver import jaxwd
+
+        dstate = self._delta
+        wcap = dstate["w_cap"]
+        n_w = 2 * wcap
+        B = len(self.pairs)
+        if obs.enabled():
+            from ..obs import costmodel as _cm
+
+            _cm.wave_begin("session")
+        with obs.span("session.delta_wave", pairs=B, w_cap=int(wcap)):
+            with obs.span("session.delta_assemble"):
+                lanes, starts, counts = assemble_delta_window(
+                    self._views, dstate["s"], dstate["anchor"],
+                    wcap, n_w)
+            r0 = dstate["s"].astype(np.int32) - 1
+            rank_w, vis_w, digest, ovf = jaxwd.batched_delta_weave(
+                *(jnp.asarray(lanes[k]) for k in LANE_KEYS5),
+                jnp.asarray(dstate["prefix_digest"]),
+                jnp.asarray(r0), u_max=n_w, k_max=n_w)
+            out = np.asarray(digest)
+            if bool(np.asarray(ovf).any()):  # pragma: no cover -
+                # structurally unreachable at u_max = N_w; kept so a
+                # future budget change degrades to correct-but-slow
+                obs.counter("session.delta_wave_overflow").inc()
+                self._delta = None
+                if obs.enabled():
+                    from ..obs import costmodel as _cm
+
+                    _cm.wave_abandon()
+                return None
+            self.last_rank, self.last_visible = jaxwd.splice_ranks(
+                self.last_rank, self.last_visible, rank_w, vis_w,
+                jnp.asarray(starts), jnp.asarray(counts),
+                jnp.asarray(r0))
+            self.last_overflow = ovf
+            if obs.enabled():
+                from ..obs import costmodel as _cm
+
+                _cm.record_dispatch(f"session:delta:w{int(wcap)}",
+                                    site="session")
+                _cm.record_dispatch("session:delta_splice",
+                                    site="session")
+        if obs.enabled():
+            from ..obs import devprof
+
+            devprof.sample_device_memory("session")
+            sem = _observe_semantics(self.pairs, out,
+                                     np.ones(B, bool), "session")
+            from ..obs import costmodel as _cm
+
+            _cm.wave_cost(
+                uuid=str(self.pairs[0][0].ct.uuid),
+                pairs=B,
+                lanes=2 * int(self.capacity) * B,
+                tokens=int(counts.sum()) + 2 * B,
+                token_budget=int(n_w) * B,
+                delta_ops=self._last_delta_lanes,
+                full_bag=0,
+                semantic=sem,
+                path="delta",
             )
             self._last_delta_lanes = 0
             self._last_update_full = False

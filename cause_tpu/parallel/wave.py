@@ -40,7 +40,8 @@ from ..weaver import lanecache
 from ..weaver.arrays import I32_MAX, next_pow2
 from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
 
-__all__ = ["merge_wave", "WaveResult", "WaveBuffers"]
+__all__ = ["merge_wave", "WaveResult", "WaveBuffers",
+           "delta_domain_ok", "assemble_delta_window"]
 
 
 @lru_cache(maxsize=8)
@@ -95,6 +96,106 @@ _PAD = {
     "hi": I32_MAX, "lo": I32_MAX, "cci": -1, "vc": 0, "valid": False,
     "seg": -1,
 }
+
+
+def delta_domain_ok(view, s: int, anchor: int,
+                    start: Optional[int] = None) -> bool:
+    """Whether lanes ``[start, view.n)`` stay inside the delta-wave
+    domain for a pair whose shared converged prefix is ``[0, s)`` with
+    anchor lane ``anchor`` (the prefix weave's final node):
+
+    - every cause resolves inside the divergent window (lane >= s) or
+      to the anchor itself — a cause stabbing any other resident lane
+      would splice new weave positions into the frozen prefix;
+    - no special (tombstone) targets the anchor — that would flip a
+      frozen resident lane's visibility.
+
+    ``start`` defaults to ``s`` (validate the whole divergent region,
+    the rebuild-time call); updates validate only their appended tail.
+    The check is O(lanes checked) vectorized numpy — the whole point
+    is that steady-state rounds pay O(delta) here."""
+    a = view.arena
+    lo = s if start is None else start
+    if lo >= view.n:
+        return True
+    ci = a.cause_idx[lo:view.n]
+    ok = (ci >= s) | (ci == anchor)
+    if not bool(np.all(ok)):
+        return False
+    if bool(np.any((a.vclass[lo:view.n] > 0) & (ci == anchor))):
+        return False
+    return True
+
+
+def assemble_delta_window(views, s_arr, anchor_arr, wcap: int,
+                          s_max: int):
+    """Build the delta wave's ``[B, 2*wcap]`` window batch from cached
+    views: per tree, lane 0 is the anchor (presented as the window
+    root: cause -1) followed by the divergent-suffix lanes
+    ``[s, n)``, causes remapped into window coordinates (anchor -> 0,
+    window lane ``j`` -> ``j - s + 1``). Returns ``(lanes, starts,
+    counts)`` with ``lanes`` the ``benchgen.LANE_KEYS5`` dict and
+    ``starts``/``counts`` the [B, 2] per-tree shared-prefix length and
+    divergent lane count the splice program consumes; ``lanes`` holds
+    every ``benchgen.LANE_KEYS5`` key. Host cost is O(total window
+    lanes) — the per-wave assembly the delta path is allowed to pay."""
+    from ..weaver.segments import _TABLE_DTYPES, tree_segments
+
+    B = len(views)
+    Nw = 2 * wcap
+    hi = np.full((B, Nw), I32_MAX, np.int32)
+    lo = np.full((B, Nw), I32_MAX, np.int32)
+    cci = np.full((B, Nw), -1, np.int32)
+    vc = np.zeros((B, Nw), np.int32)
+    valid = np.zeros((B, Nw), bool)
+    seg = np.full((B, Nw), -1, np.int32)
+    tables = {k: np.zeros((B, s_max), _TABLE_DTYPES[k])
+              for k in SEG_LANE_KEYS}
+    starts = np.zeros((B, 2), np.int32)
+    counts = np.zeros((B, 2), np.int32)
+    for r, (va, vb) in enumerate(views):
+        s = int(s_arr[r])
+        anchor = int(anchor_arr[r])
+        per_tree = []
+        for t, v in enumerate((va, vb)):
+            a = v.arena
+            d = v.n - s
+            w = 1 + d
+            off = t * wcap
+            hi[r, off] = np.int32(a.ts[anchor])
+            lo[r, off] = a.spec.pack_lo(a.site[anchor:anchor + 1],
+                                        a.tx[anchor:anchor + 1])[0]
+            valid[r, off] = True
+            if d:
+                sl = slice(s, v.n)
+                hi[r, off + 1:off + w] = a.ts[sl]
+                lo[r, off + 1:off + w] = a.spec.pack_lo(a.site[sl],
+                                                        a.tx[sl])
+                ci = a.cause_idx[sl]
+                local = np.where(ci == anchor, 0,
+                                 ci - s + 1).astype(np.int32)
+                cci[r, off + 1:off + w] = local + off
+                vc[r, off + 1:off + w] = a.vclass[sl]
+                valid[r, off + 1:off + w] = True
+            local_cci = np.full(wcap, -1, np.int32)
+            if d:
+                local_cci[1:w] = np.where(ci == anchor, 0, ci - s + 1)
+            segs = tree_segments(hi[r, off:off + wcap],
+                                 lo[r, off:off + wcap],
+                                 local_cci, vc[r, off:off + wcap], w)
+            per_tree.append((segs, w))
+            starts[r, t] = s
+            counts[r, t] = d
+        row_out = {k: tables[k][r] for k in SEG_LANE_KEYS}
+        _t, bases = concat_seg_tables(per_tree, wcap, s_max,
+                                      out=row_out)
+        for t, ((segs, w), base) in enumerate(zip(per_tree, bases)):
+            off = t * wcap
+            seg[r, off:off + w] = segs["run_of_lane"][:w] + base
+    lanes = {"hi": hi, "lo": lo, "cci": cci, "vc": vc, "valid": valid,
+             "seg": seg}
+    lanes.update(tables)
+    return lanes, starts, counts
 
 
 def _observe_semantics(pairs, digests, valid, source: str):
@@ -442,7 +543,8 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
 
             _cm.wave_cost(uuid=str(pairs[0][0].ct.uuid), pairs=B,
                           lanes=0, full_bag=len(fallback),
-                          poisoned=len(poisoned), semantic=sem)
+                          poisoned=len(poisoned), semantic=sem,
+                          path="full")
         return WaveResult(pairs, views, 0,
                           np.zeros((B, 0), np.int32),
                           np.zeros((B, 0), bool),
@@ -624,7 +726,8 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
                       tokens=int(u_need) * len(live_views),
                       token_budget=int(u_max) * len(live_views),
                       full_bag=len(fallback), poisoned=len(poisoned),
-                      overflow_retries=n_retried, semantic=sem)
+                      overflow_retries=n_retried, semantic=sem,
+                      path="full")
     return WaveResult(pairs, views, cap, full_rank, full_vis, full_dig,
                       fallback, pipeline, dig_valid,
                       poisoned=poisoned)

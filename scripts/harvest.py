@@ -254,6 +254,24 @@ def main() -> None:
     budget = benchgen.pair_run_budget(batch)
     emit(ev="marshal", ms=round((time.monotonic() - t0) * 1000, 1))
 
+    # delta-native arms (PR 7), still pre-claim: window-only marshals
+    # for the low/high bench_delta timing items (same document shape,
+    # divergence-sized windows) plus a B=64 full+window subset for the
+    # verify_delta digest gate — small enough that the gate's full
+    # -kernel control compiles in a fraction of the headline compile
+    t0 = time.monotonic()
+    ND_LOW = max(1, min(25, ND))
+    dsw_low = benchgen.delta_sweep_inputs(
+        B, NB + ND - ND_LOW, ND_LOW, CAP, hide_every=8,
+        include_full=False)
+    dsw_high = benchgen.delta_sweep_inputs(
+        B, NB, ND, CAP, hide_every=8, include_full=False)
+    dsw_verify = benchgen.delta_sweep_inputs(
+        min(64, B), NB, ND, CAP, hide_every=8)
+    emit(ev="marshal_delta",
+         ms=round((time.monotonic() - t0) * 1000, 1),
+         wcap_low=dsw_low["wcap"], wcap_high=dsw_high["wcap"])
+
     # Bounded backend claim (shared guard; see claimguard docstring):
     # hard-exit if the tunnel claim wedges past HARVEST_CLAIM_DEADLINE,
     # disarmed before any compile can be in flight.
@@ -420,7 +438,7 @@ def main() -> None:
                         lanes=2 * CAP * B,
                         tokens=k * B if v5_family else None,
                         token_budget=k * B if v5_family else 0,
-                        delta_ops=2 * ND * B)
+                        delta_ops=2 * ND * B, path="full")
             # bench.py's adaptive-burst rule (window economy, and the
             # window-2 lesson — a slow kernel's 3 bursts are ~90 s of
             # window for nothing): when single > 1 s the ~64-70 ms
@@ -807,6 +825,125 @@ def main() -> None:
             emit(ev="error", item=name,
                  error=f"{type(e).__name__}: {str(e)[:200]}")
 
+    def verify_delta_item(name):
+        """On-chip digest gate for the delta-native weave: per-row
+        convergence digests of the full v5 kernel vs the delta window
+        program (prefix digest + window weave) on a B=64 subset of the
+        headline shape restricted to the delta domain. MATCH means
+        bit-identical uint32 digests on every row — the same gate the
+        CPU equality suite pins, re-proven on the chip's own lowering.
+        Deliberately NOT part of BESTSTREAM: the delta path ships into
+        defaults only after this gate has certified it on hardware."""
+        from cause_tpu.weaver import jaxwd
+        from cause_tpu.weaver.arrays import next_pow2
+
+        full_args = [jax.device_put(jnp.asarray(dsw_verify["full"][k]))
+                     for k in LANE_KEYS5]
+        u = next_pow2(benchgen.v5_token_budget(dsw_verify["full"]))
+        _r, _v, dig_full, ovf = jaxwd.batched_weave_digest(
+            *full_args, u_max=int(u), k_max=int(u))
+        dig_full = np.asarray(dig_full)
+        ov_full = int(np.asarray(ovf).sum())
+        nw = 2 * dsw_verify["wcap"]
+        win_args = [jax.device_put(jnp.asarray(dsw_verify["window"][k]))
+                    for k in LANE_KEYS5]
+        _rw, _vw, dig_d, ovw = jaxwd.batched_delta_weave(
+            *win_args, jax.device_put(dsw_verify["prefix_digest"]),
+            jax.device_put(dsw_verify["r0"]),
+            u_max=int(nw), k_max=int(nw))
+        dig_d = np.asarray(dig_d)
+        ov_d = int(np.asarray(ovw).sum())
+        ok = (ov_full == 0 and ov_d == 0
+              and bool(np.array_equal(dig_full, dig_d)))
+        rec = dict(item=name, verdict="MATCH" if ok else "MISMATCH",
+                   rows=int(dig_full.shape[0]),
+                   overflow_full=ov_full, overflow_delta=ov_d,
+                   wcap=dsw_verify["wcap"],
+                   # the gate runs on its own row subset — the shape
+                   # label must say so, not claim the headline batch
+                   shape=f"{int(dig_full.shape[0])}x{1+NB+ND}",
+                   platform=plat, run=RUN_ID)
+        emit(ev="result", **rec)
+        if record_state:
+            results[name] = rec
+            if ok:
+                done.add(name)
+            save_state(done, results)
+
+    def delta_bench_item(name, dsw, n_div_side):
+        """bench.py-methodology timing of the delta-native wave
+        program — window weave + incremental digest + resident splice
+        — at the headline batch size and document shape, with the
+        window sized to the item's divergence. Residents are device
+        -allocated placeholders (the splice's cost is content
+        -independent); correctness is verify_delta's gate, this item
+        is the wall-clock arm of the one-claim A/B vs bench_v5."""
+        from cause_tpu.weaver import jaxwd
+
+        nw = 2 * dsw["wcap"]
+        win = [jax.device_put(jnp.asarray(dsw["window"][k]))
+               for k in LANE_KEYS5]
+        pd = jax.device_put(dsw["prefix_digest"])
+        r0v = jax.device_put(dsw["r0"])
+        st = jax.device_put(dsw["starts"])
+        ct = jax.device_put(dsw["counts"])
+        res = [jnp.zeros((B, 2 * CAP), jnp.int32),
+               jnp.zeros((B, 2 * CAP), bool)]
+
+        def dispatch():
+            rw, vw, dig, _ovf = jaxwd.batched_delta_weave(
+                *win, pd, r0v, u_max=int(nw), k_max=int(nw))
+            res[0], res[1] = jaxwd.splice_ranks(
+                res[0], res[1], rw, vw, st, ct, r0v)
+            if obs.enabled():
+                from cause_tpu.obs import costmodel as _cm
+
+                _cm.record_dispatch(f"harvest:delta:w{dsw['wcap']}",
+                                    site="harvest")
+                _cm.record_dispatch("harvest:delta_splice",
+                                    site="harvest")
+            # sync value depends on BOTH programs: fetching the digest
+            # alone would let the O(doc) splice run past the timer
+            return jnp.concatenate(
+                [dig, res[0][:, 0].astype(jnp.uint32)])
+
+        np.asarray(dispatch())  # compile + warm
+
+        def _begin():
+            if obs.enabled():
+                from cause_tpu.obs import costmodel as _cm
+
+                _cm.wave_begin("harvest")
+
+        def _end():
+            if obs.enabled():
+                from cause_tpu.obs import costmodel as _cm
+
+                _cm.wave_cost(
+                    uuid=f"harvest:{name}", pairs=B,
+                    lanes=2 * CAP * B,
+                    tokens=2 * (n_div_side + 1) * B,
+                    token_budget=int(nw) * B,
+                    delta_ops=2 * n_div_side * B, path="delta")
+
+        singles, bursts = benchgen.time_dispatch(
+            dispatch, reps, 8, begin=_begin, end=_end)
+        rec = dict(
+            item=name, kernel="v5d", config="delta-native",
+            cfg={},
+            p50_single_ms=round(float(np.median(singles)), 2),
+            p50_amortized_ms=round(float(np.median(bursts)), 2),
+            singles_ms=[round(x, 2) for x in singles],
+            bursts_ms=[round(x, 2) for x in bursts],
+            k_max=int(nw), wcap=dsw["wcap"],
+            divergence_ops=2 * n_div_side,
+            platform=plat, shape=f"{B}x{1+NB+ND}", run=RUN_ID)
+        emit(ev="result", **rec)
+        if record_state:
+            results[name] = rec
+            done.add(name)
+            save_state(done, results)
+
     # ---- the ladder, highest information value per second first -----
     # Round-5 order after window 1: the XLA-only streaming family is
     # the only measurable candidate on this tunnel (Mosaic compiles
@@ -828,6 +965,16 @@ def main() -> None:
         # rule could never (re-)certify after window 1
         ("bench_beststream", beststream_bench_item,
          ("bench_beststream",)),
+        # delta-native weave (PR 7): the digest gate plus low/high
+        # -divergence timing arms, so the FIRST window A/Bs
+        # delta-native vs full weave (bench_v5 above) in one claim.
+        # Not in BESTSTREAM: the delta path only ships as a default
+        # once verify_delta has certified it on hardware.
+        ("verify_delta", verify_delta_item, ("verify_delta",)),
+        ("bench_delta_high", delta_bench_item,
+         ("bench_delta_high", dsw_high, ND)),
+        ("bench_delta_low", delta_bench_item,
+         ("bench_delta_low", dsw_low, ND_LOW)),
         ("bench_rowgather", bench_item,
          ("bench_rowgather", "v5", cfg_of(CAUSE_TPU_GATHER="rowgather"))),
         ("bench_matrix", bench_item,

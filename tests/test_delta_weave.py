@@ -1,0 +1,450 @@
+"""The delta-native device weave (PR 7): steady-state wave cost
+proportional to divergence, not document size.
+
+Pins the tentpole contract end to end:
+
+- the avalanche-mix twins (``mesh.replica_digest`` / ``mesh.mix32_np``
+  / ``mesh.mix32``) agree bit-for-bit — the incremental digest
+  depends on it;
+- generator-level identity: the full v5 kernel's digest equals the
+  frozen prefix digest plus the delta window program's contribution,
+  and the spliced ranks/visibility equal the full kernel's, for every
+  sweep shape including tombstoned suffixes;
+- FleetSession routing: steady-state rounds ride the delta wave (the
+  ``wave.cost`` ``path`` field proves it) and stay bit-identical to
+  ``merge_wave``/pairwise ``merge``, across conj/extend/tombstone
+  edit patterns, zero initial divergence, and sync-shared suffixes;
+- resident-weave invalidation: anchor tombstones, window-budget
+  overflow, GC compaction under a resident weave, and interner rank
+  reassignment all fall back to the full-width wave (correct, just
+  O(doc)) and re-establish afterwards;
+- obs-off invariance: the routing decisions are identical with obs
+  disabled, no records and no cost-model state appear;
+- the gap report renders per-path slope verdicts (the sweep's
+  acceptance artifact: O(delta) for the delta path, O(doc) for the
+  full-weave control).
+"""
+
+import numpy as np
+import pytest
+
+import cause_tpu as c
+from cause_tpu import obs, sync
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id
+from cause_tpu.obs import costmodel, semantic
+from cause_tpu.parallel import merge_wave
+from cause_tpu.parallel.session import FleetSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    semantic.reset()
+    costmodel.reset()
+    yield
+    obs.reset()
+    semantic.reset()
+    costmodel.reset()
+
+
+def warm(cl):
+    return CausalList(c_list.weave(cl.ct))
+
+
+def make_base(n=40):
+    base = warm(c.clist(weaver="jax").extend(
+        [f"w{i}" for i in range(n)]
+    ))
+    base.ct.lanes.segments()
+    return base
+
+
+def make_pairs(base, n_pairs, n_div_a=6, n_div_b=4):
+    pairs = []
+    for p in range(n_pairs):
+        a = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+            [f"a{p}.{i}" for i in range(n_div_a)]
+        )
+        b = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+            [f"b{p}.{i}" for i in range(n_div_b)]
+        )
+        pairs.append((a, b))
+    return pairs
+
+
+def _wave_paths():
+    return [e["fields"].get("path") for e in obs.events()
+            if e.get("ev") == "event" and e.get("name") == "wave.cost"
+            and e["fields"].get("source") == "session"]
+
+
+# ------------------------------------------------------- mix identity
+
+
+def test_avalanche_twins_agree_with_replica_digest():
+    import jax.numpy as jnp
+
+    from cause_tpu.parallel.mesh import mix32, mix32_np, replica_digest
+
+    rng = np.random.RandomState(7)
+    n = 64
+    hi = rng.randint(0, 2**30, n).astype(np.int32)
+    lo = rng.randint(0, 2**30, n).astype(np.int32)
+    rank = rng.permutation(n).astype(np.int32)
+    rank[5:9] = n  # dropped lanes
+    vis = rng.rand(n) > 0.3
+    ref = int(np.asarray(replica_digest(
+        jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(rank),
+        jnp.asarray(vis))))
+    kept = rank < n
+    host = int(mix32_np(hi, lo, rank, vis)[kept]
+               .sum(dtype=np.uint64) & np.uint64(0xFFFFFFFF))
+    dev = int(np.asarray(
+        jnp.sum(jnp.where(jnp.asarray(kept),
+                          mix32(jnp.asarray(hi), jnp.asarray(lo),
+                                jnp.asarray(rank), jnp.asarray(vis)),
+                          jnp.uint32(0)))))
+    assert host == ref == dev
+
+
+# ------------------------------------------- generator-level identity
+
+
+@pytest.mark.parametrize("shape", [
+    (4, 120, 40, 256, 8),   # tombstones every 8th suffix node
+    (3, 60, 5, 128, 3),     # dense tombstones
+    (2, 200, 1, 256, 0),    # single-op divergence
+    (5, 50, 30, 128, 2),
+])
+def test_generator_full_vs_delta_digest_identity(shape):
+    """full-kernel digest == prefix digest + window contribution, and
+    the spliced ranks/visibility equal the full kernel's, bit for
+    bit — the identity the whole delta generation stands on."""
+    import jax.numpy as jnp
+
+    from cause_tpu import benchgen
+    from cause_tpu.benchgen import LANE_KEYS5
+    from cause_tpu.weaver import jaxwd
+    from cause_tpu.weaver.arrays import next_pow2
+
+    B, nb, nd, cap, he = shape
+    sw = benchgen.delta_sweep_inputs(B, nb, nd, cap, hide_every=he)
+    u = next_pow2(benchgen.v5_token_budget(sw["full"]))
+    rank, vis, dig_full, ovf = jaxwd.batched_weave_digest(
+        *(jnp.asarray(sw["full"][k]) for k in LANE_KEYS5),
+        u_max=int(u), k_max=int(u))
+    assert not np.asarray(ovf).any()
+    nw = 2 * sw["wcap"]
+    rw, vw, dig_delta, ovw = jaxwd.batched_delta_weave(
+        *(jnp.asarray(sw["window"][k]) for k in LANE_KEYS5),
+        jnp.asarray(sw["prefix_digest"]), jnp.asarray(sw["r0"]),
+        u_max=int(nw), k_max=int(nw))
+    assert not np.asarray(ovw).any()
+    assert np.array_equal(np.asarray(dig_full), np.asarray(dig_delta))
+
+    rf, vf = jaxwd.splice_ranks(
+        jnp.asarray(np.full((B, 2 * cap), 2 * cap, np.int32)),
+        jnp.asarray(np.zeros((B, 2 * cap), bool)),
+        rw, vw, jnp.asarray(sw["starts"]), jnp.asarray(sw["counts"]),
+        jnp.asarray(sw["r0"]))
+    s0 = nb + 1
+    for t in range(2):
+        sl = slice(t * cap + s0, t * cap + s0 + nd)
+        assert np.array_equal(np.asarray(rank)[:, sl],
+                              np.asarray(rf)[:, sl])
+        assert np.array_equal(np.asarray(vis)[:, sl],
+                              np.asarray(vf)[:, sl])
+
+
+# --------------------------------------------------- session routing
+
+
+def test_session_steady_state_rides_delta_path():
+    """Multi-round incremental editing (conj, extend, own-suffix
+    tombstones) rides the delta wave and stays bit-identical to
+    merge_wave — and materialization still matches pairwise merge."""
+    obs.configure(enabled=True)
+    base = make_base(60)
+    pairs = make_pairs(base, 4)
+    # extra headroom so segment-table growth doesn't force re-uploads
+    # mid-test (that fallback is exercised separately below)
+    sess = FleetSession(pairs)
+    sess.wave()
+    for rnd in range(3):
+        pairs = [(a.conj(f"x{rnd}").extend([f"y{rnd}"]),
+                  b.conj(f"q{rnd}")) for a, b in pairs]
+        if rnd == 1:  # tombstone a's own suffix tail (window-local)
+            pairs = [(a.append(list(a)[-1][0], c.hide), b)
+                     for a, b in pairs]
+        sess.update(pairs)
+        d = sess.wave()
+        ref = merge_wave(pairs)
+        assert np.array_equal(d, ref.digest)
+    assert c.causal_to_edn(sess.merged(0)) == c.causal_to_edn(
+        pairs[0][0].merge(pairs[0][1]))
+    paths = _wave_paths()
+    assert paths[0] == "full"
+    # at least one steady-state round actually rode the delta wave
+    # (segment-table growth may legitimately bounce one round back to
+    # a full upload)
+    assert "delta" in paths[1:]
+    # delta waves carry the spliced lane count as divergence evidence
+    costs = [e["fields"] for e in obs.events()
+             if e.get("ev") == "event"
+             and e.get("name") == "wave.cost"
+             and e["fields"].get("path") == "delta"]
+    assert all(f["delta_ops"] > 0 for f in costs)
+    assert all(f["dispatches"] >= 2 for f in costs)  # weave + splice
+
+
+def test_session_zero_initial_divergence_and_shared_suffix():
+    # 41 lanes: clear of the pow2 capacity boundary, so appends don't
+    # trip the pre-existing capacity-growth re-upload mid-test
+    base = make_base(40)
+    a = CausalList(base.ct.evolve(site_id=new_site_id()))
+    b = CausalList(base.ct.evolve(site_id=new_site_id()))
+    sess = FleetSession([(a, b)] * 3)
+    sess.wave()
+    assert sess._delta is not None
+    # early rounds mint the suffix sites' first segments — segment
+    # -table growth legitimately bounces SOME round to a full
+    # re-upload on small fleets (which round depends on random site
+    # -rank order); correctness must hold every round and the delta
+    # wave must ride once the suffix chains glue
+    pairs = [(a, b)] * 3
+    rode_delta = False
+    for rnd in range(3):
+        pairs = [(x.conj(f"A{rnd}"), y.conj(f"B{rnd}"))
+                 for x, y in pairs[:1]] * 3
+        sess.update(pairs)
+        rode_delta = rode_delta or sess._delta is not None
+        assert np.array_equal(sess.wave(), merge_wave(pairs).digest)
+    assert rode_delta
+
+    # sync-shared suffix nodes: both trees hold the same divergent
+    # nodes (twins inside the window) plus fresh private edits
+    a2 = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+        ["p", "q"])
+    b2 = CausalList(base.ct.evolve(site_id=new_site_id())).extend(
+        ["r"])
+    a2s, b2s = sync.sync_pair(a2, b2)
+    sess2 = FleetSession([(a2s, b2s)] * 2)
+    sess2.wave()
+    p3 = [(a2s, b2s)] * 2
+    rode_delta = False
+    for rnd in range(3):
+        p3 = [(x.conj(f"m{rnd}"), y.conj(f"s{rnd}"))
+              for x, y in p3[:1]] * 2
+        sess2.update(p3)
+        rode_delta = rode_delta or sess2._delta is not None
+        assert np.array_equal(sess2.wave(), merge_wave(p3).digest)
+    assert rode_delta
+
+
+# ----------------------------------------------- invalidation matrix
+
+
+def test_anchor_tombstone_falls_back_to_full_wave():
+    """A hide targeting the anchor (the converged weave's final node)
+    would flip a frozen resident lane's visibility: the session must
+    drop the delta capability and run the full-width wave — and stay
+    correct."""
+    base = make_base(30)
+    a = CausalList(base.ct.evolve(site_id=new_site_id()))
+    b = CausalList(base.ct.evolve(site_id=new_site_id()))
+    sess = FleetSession([(a, b)] * 2)
+    sess.wave()
+    assert sess._delta is not None
+    anchor_id = list(a)[-1][0]  # base tail == converged weave tail
+    p2 = [(a.append(anchor_id, c.hide), b.conj("v"))] * 2
+    sess.update(p2)
+    assert sess._delta is None  # capability dropped at update time
+    assert np.array_equal(sess.wave(), merge_wave(p2).digest)
+
+
+def test_window_budget_overflow_rebuilds_then_reestablishes():
+    """Divergence outgrowing the session's pow2 window budget is the
+    'token-budget overflow' rebuild: the wave falls back to full
+    width, which re-establishes a larger window."""
+    obs.configure(enabled=True)
+    base = make_base(30)
+    pairs = [(CausalList(base.ct.evolve(site_id=new_site_id())),
+              CausalList(base.ct.evolve(site_id=new_site_id())))]
+    sess = FleetSession(pairs, d_max=4)
+    sess.wave()
+    w0 = sess._delta["w_cap"]
+    assert w0 == 8  # pow2(0 divergence + 1 + d_max)
+    saw_invalidate = False
+    for rnd in range(4):
+        pairs = [(a.conj(f"r{rnd}a1").conj(f"r{rnd}a2"),
+                  b.conj(f"r{rnd}b1").conj(f"r{rnd}b2"))
+                 for a, b in pairs]
+        sess.update(pairs)
+        if sess._delta is None:
+            saw_invalidate = True
+        assert np.array_equal(sess.wave(), merge_wave(pairs).digest)
+    assert saw_invalidate
+    assert sess._delta is not None  # re-established…
+    assert sess._delta["w_cap"] > w0  # …with the next budget bucket
+
+
+def test_gc_compaction_under_resident_weave_falls_back():
+    """GC compaction rewrites a tree's history: the session's
+    rewritten-history check must force a full re-upload (delta state
+    dropped), and everything stays correct afterwards."""
+    from cause_tpu.gc import compact
+
+    base = make_base(30)
+    pairs = make_pairs(base, 2, n_div_a=4, n_div_b=3)
+    sess = FleetSession(pairs)
+    sess.wave()
+    assert sess._delta is not None
+    a0, b0 = pairs[0]
+    for _ in range(3):  # tail-delete chain: the shape compact reclaims
+        a0 = a0.append(list(a0)[-1][0], c.hide)
+    a0c = compact(a0)
+    assert len(a0c.ct.nodes) < len(a0.ct.nodes)
+    pairs2 = [(a0c, b0)] + pairs[1:]
+    sess.update(pairs2)
+    d = sess.wave()
+    ref = merge_wave(pairs2)
+    assert np.array_equal(d, ref.digest)
+    for i, (x, y) in enumerate(pairs2):
+        assert c.causal_to_edn(sess.merged(i)) == c.causal_to_edn(
+            x.merge(y))
+
+
+def test_rank_reassignment_invalidates_delta_state():
+    """A gap-exhaustion rank reassignment repacks every lo — the
+    frozen prefix digest would be stale. The generation check must
+    route through a full re-upload; digests stay correct and the
+    delta path re-establishes on the next full wave."""
+    base = make_base(30)
+    pairs = make_pairs(base, 2)
+    sess = FleetSession(pairs)
+    sess.wave()
+    assert sess._delta is not None
+    sess._views[0][0].interner._reassign()
+    pairs2 = [(a.conj("post"), b) for a, b in sess.pairs]
+    sess.update(pairs2)
+    assert sess._delta is None  # full upload dropped it
+    assert np.array_equal(sess.wave(), merge_wave(pairs2).digest)
+    assert sess._delta is not None
+
+
+def test_delta_disabled_session_stays_full_width():
+    obs.configure(enabled=True)
+    base = make_base(30)
+    pairs = make_pairs(base, 2)
+    sess = FleetSession(pairs, delta=False)
+    sess.wave()
+    pairs = [(a.conj("x"), b.conj("y")) for a, b in pairs]
+    sess.update(pairs)
+    assert np.array_equal(sess.wave(), merge_wave(pairs).digest)
+    assert sess._delta is None
+    assert all(p == "full" for p in _wave_paths())
+
+
+# -------------------------------------------------- obs-off invariance
+
+
+def test_obs_off_invariance_of_delta_path(tmp_path):
+    """With obs disabled the delta path must record NOTHING (no
+    events, no cost-model state, no sink) while making the SAME
+    routing decisions — the digests prove the same programs ran."""
+    out = str(tmp_path / "never.jsonl")
+    obs.configure(enabled=False, out=out)
+    base = make_base(30)
+    pairs = make_pairs(base, 2)
+    sess = FleetSession(pairs)
+    d0 = sess.wave()
+    assert sess._delta is not None  # routing is obs-independent
+    pairs = [(a.conj("x"), b.conj("y")) for a, b in pairs]
+    sess.update(pairs)
+    assert sess._delta is not None
+    d1 = sess.wave()
+    import os
+
+    assert obs.events() == []
+    assert obs.counters_snapshot() == {"counters": {}, "gauges": {}}
+    assert not os.path.exists(out)
+    assert costmodel._PROGRAMS == {}
+    assert costmodel._PENDING_OPS == {}
+
+    # identical digests from an obs-ON full wave over the same edited
+    # fleet: the delta route dispatched programs that converge to the
+    # same state, independent of obs
+    obs.configure(enabled=True)
+    semantic.reset()
+    costmodel.reset()
+    sess2 = FleetSession(pairs)
+    d1_on = sess2.wave()
+    assert np.array_equal(d1, d1_on)
+    assert d0 is not None and not np.array_equal(d0, d1)
+
+
+# ------------------------------------------------------- gap by path
+
+
+def test_gap_report_renders_per_path_verdicts():
+    """The acceptance artifact's shape: a stream carrying both wave
+    generations renders TWO slope verdicts — O(delta) for the delta
+    path, O(doc) for the full-weave control."""
+    def ev(path, d, wall):
+        return {"ev": "event", "name": "wave.cost",
+                "fields": {"uuid": "u", "source": "bench",
+                           "path": path, "pairs": 1024,
+                           "lanes": 20480 * 1024, "delta_ops": d,
+                           "full_bag": 0, "dispatches": 2,
+                           "programs": 2, "wall_ms": wall}}
+
+    waves = []
+    for d in (10, 50, 500, 5000):
+        waves.append(ev("full", d * 1024, 5300.0 + d * 0.001))
+        waves.append(ev("delta", d * 1024, 20.0 + d * 0.4))
+    rep = costmodel.gap_report([], waves)
+    by = rep["cost_vs_divergence_by_path"]
+    assert by["delta"]["verdict"] == "O(delta)"
+    assert by["full"]["verdict"] == "O(doc)"
+    text = costmodel.render_gap(rep)
+    assert "path delta" in text and "path full" in text
+    assert "O(delta)" in text and "O(doc)" in text
+
+
+@pytest.mark.slow
+def test_bench_divergence_sweep_smoke(tmp_path):
+    """BENCH_DIV_SWEEP end to end at smoke scale: per-level digest
+    agreement, per-level sweep ledger rows, per-path gap verdicts."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    led = str(tmp_path / "ledger.jsonl")
+    sidecar = str(tmp_path / "obs.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_SMOKE="1",
+               BENCH_DIV_SWEEP="4,40", CAUSE_TPU_OBS="1",
+               CAUSE_TPU_OBS_OUT=sidecar, CAUSE_TPU_LEDGER=led)
+    r = subprocess.run([sys.executable, "bench.py"], env=env,
+                       capture_output=True, text=True, cwd=repo,
+                       timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["digest_agreed"] is True
+    assert len(rec["levels"]) == 2
+    assert all(lv["digest_agreed"] for lv in rec["levels"])
+    from cause_tpu.obs import ledger as ledger_mod
+
+    rows = ledger_mod.load(led)
+    assert sorted(r_["config"] for r_ in rows) == [
+        "div4-delta", "div4-full", "div40-delta", "div40-full"]
+    assert all(r_["kind"] == "sweep" for r_ in rows)
+    # per-path curves reach the gap report from the sidecar
+    from cause_tpu.obs import load_jsonl
+    from cause_tpu.obs.costmodel import gap_report
+
+    rep = gap_report([], load_jsonl(sidecar))
+    assert set(rep["cost_vs_divergence_by_path"]) == {"delta", "full"}
