@@ -202,6 +202,143 @@ def _sweep_levels() -> list:
     return levels
 
 
+def _tree_sizes() -> list:
+    """Parse BENCH_TREE ("64" or "64,256": replica counts per fleet).
+    Empty when the merge-tree bench mode is off."""
+    raw = os.environ.get("BENCH_TREE", "").strip()
+    if not raw:
+        return []
+    try:
+        sizes = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        raise SystemExit(f"bench: BENCH_TREE must be a comma-separated "
+                         f"list of replica counts; got {raw!r}")
+    if any(n < 4 for n in sizes):
+        raise SystemExit("bench: BENCH_TREE fleets need >= 4 replicas "
+                         "(smaller fleets have no tree to speak of)")
+    return sizes
+
+
+def _tree_bench(real_platform: str, tag: str, smoke: bool, reps: int,
+                bail, marshals: list, doc: int, div: int) -> dict:
+    """The merge-tree bench (BENCH_TREE): converge each fleet of REAL
+    divergent replica handles through the merge reduction tree
+    (``parallel.tree.merge_tree``) AND through the flat sequential
+    pairwise fold it replaces, gate the two roots on bit-identity
+    (weave + node store), and land one ``--kind tree`` ledger row per
+    (fleet, arm). Per-level evidence — ``tree.level`` semantic events,
+    per-level ``wave.cost`` with round count == ceil(log2(n)) and the
+    post-level-0 delta share — streams into the obs sidecar; ``obs
+    gap`` renders the per-level decomposition.
+
+    The fold arm runs ONE rep: it is n-1 SEQUENTIAL full-width waves
+    with per-step host materialization (minutes at the north-star
+    shape) — repeating it buys nothing but wall clock, and the tree
+    arm's reps carry the repetition evidence."""
+    import numpy as np
+
+    from cause_tpu.parallel import tree as tree_mod
+
+    rows = []
+    agree_all = True
+    for n, handles in marshals:
+        bail()
+        # warm phase obs-off: first-trace compile spikes must not
+        # pollute the measured per-level curve (same rule as the
+        # delta-wave CI smoke)
+        obs_was_on = obs.enabled()
+        if obs_was_on:
+            obs.configure(enabled=False)
+        with obs.span("bench.tree.warm", n=n):
+            tree_mod.merge_tree(handles)
+        if obs_was_on:
+            obs.configure(enabled=True)
+
+        tree_ms = []
+        report = None
+        for _ in range(reps):
+            bail()
+            t0 = time.perf_counter()
+            root, report = tree_mod.merge_tree_report(handles)
+            tree_ms.append((time.perf_counter() - t0) * 1000.0)
+        tree_p50 = float(np.median(tree_ms))
+        bail()
+        t0 = time.perf_counter()
+        fold = tree_mod.flat_fold(handles)
+        fold_ms = (time.perf_counter() - t0) * 1000.0
+
+        agreed = (root.ct.weave == fold.ct.weave
+                  and root.ct.nodes == fold.ct.nodes)
+        agree_all = agree_all and agreed
+        paths = [lv["path"] for lv in report["levels"]]
+        post = paths[1:]
+        level_row = {
+            "replicas": n, "doc": doc + 1, "div_ops": div,
+            "rounds": len(report["levels"]),
+            "rounds_expected": tree_mod.tree_rounds(n),
+            "paths": paths,
+            "post_level0_delta_share": (
+                round(sum(1 for p in post if p == "delta") / len(post), 4)
+                if post else None),
+            "tree_p50_ms": round(tree_p50, 3),
+            "tree_reps_ms": [round(x, 3) for x in tree_ms],
+            "fold_ms": round(fold_ms, 3),
+            "tree_over_fold": round(tree_p50 / max(fold_ms, 1e-9), 4),
+            "bit_identical": agreed,
+        }
+        rows.append(level_row)
+        print(f"bench: tree n={n}: {tree_p50:.1f} ms over "
+              f"{len(report['levels'])} round(s) vs fold "
+              f"{fold_ms:.1f} ms ({100 * level_row['tree_over_fold']:.1f}%), "
+              + ("BIT-IDENTICAL" if agreed else "MISMATCH"),
+              file=sys.stderr)
+        if not agreed:
+            print(f"bench: tree n={n}: roots DISAGREE — skipping this "
+                  "fleet's ledger rows", file=sys.stderr)
+            continue
+        try:
+            from cause_tpu.obs import ledger
+
+            # per-arm metadata: the fold is n-1 SEQUENTIAL full-width
+            # rounds — stamping the tree's rounds/paths on its row
+            # would commit evidence claiming the O(n) baseline rode
+            # the tree's shape
+            arms = (
+                ("tree", tree_p50, {"rounds": level_row["rounds"],
+                                    "paths": paths}),
+                ("fold", fold_ms, {"rounds": n - 1,
+                                   "sequential": True}),
+            )
+            for arm, val, arm_extra in arms:
+                ledger.ingest_record(
+                    {"platform": tag or real_platform,
+                     "metric": f"fleet convergence ({arm}), {n} "
+                               f"replicas x {doc + 1}-node CausalLists",
+                     "value": round(val, 3),
+                     "kernel": "v5t" if arm == "tree" else "v5",
+                     "config": f"n{n}-{arm}",
+                     "schema_version": BENCH_SCHEMA_VERSION},
+                    source=f"bench-tree@{time.strftime('%Y-%m-%d')}",
+                    kind="tree",
+                    extra=dict(arm_extra, bit_identical=True))
+        except Exception as e:  # noqa: BLE001 - best-effort rows
+            print(f"bench: tree ledger append failed ({e})",
+                  file=sys.stderr)
+    obs.flush()
+    return {
+        "metric": f"merge tree vs flat fold fleet convergence, "
+                  f"{doc + 1}-node CausalLists"
+                  + (" [smoke size]" if smoke else ""),
+        "value": None,
+        "unit": "ms",
+        "fleets": rows,
+        "bit_identical": agree_all,
+        "vs_baseline": 0.0,
+        "platform": tag or real_platform,
+        "schema_version": BENCH_SCHEMA_VERSION,
+    }
+
+
 def _divergence_sweep(real_platform: str, tag: str, smoke: bool,
                       reps: int, bail, marshals, B: int, doc: int,
                       cap: int) -> dict:
@@ -422,6 +559,36 @@ def _divergence_sweep(real_platform: str, tag: str, smoke: bool,
     }
 
 
+def _claim_backend(platform: str):
+    """The one backend-claim sequence the marshal-first bench modes
+    (divergence sweep, merge tree) share: compile cache on the TPU
+    path (this performs the blocking tunnel claim), platform confirm,
+    the BENCH_SENTINEL write that extends the parent's deadline, the
+    abandoned-tombstone bail closure, and the artifact tag. Returns
+    ``(real_platform, tag, bail)``."""
+    import jax
+
+    from cause_tpu.benchgen import enable_compile_cache
+
+    if platform != "cpu":
+        enable_compile_cache()
+    real_platform = jax.devices()[0].platform
+    obs.set_platform(real_platform)
+    sentinel = os.environ.get("BENCH_SENTINEL")
+    if sentinel:
+        with open(sentinel, "w") as f:
+            f.write(real_platform)
+
+    def bail():
+        if sentinel and os.path.exists(sentinel + ".abandoned"):
+            print("bench child: parent abandoned this attempt; "
+                  "exiting", file=sys.stderr)
+            raise SystemExit(4)
+
+    tag = os.environ.get("BENCH_TAG") or real_platform
+    return real_platform, tag, bail
+
+
 def _timed_once(step, k_max, kernel) -> float:
     t0 = time.perf_counter()
     step(k_max, kernel)
@@ -510,26 +677,31 @@ def measure(platform: str) -> dict:
                 marshals.append((d, benchgen.delta_sweep_inputs(
                     sw_B, sw_doc - d // 2, d // 2, sw_cap,
                     hide_every=8)))
-        if platform != "cpu":
-            enable_compile_cache()
-        real_platform = jax.devices()[0].platform
-        obs.set_platform(real_platform)
-        sentinel = os.environ.get("BENCH_SENTINEL")
-        if sentinel:
-            with open(sentinel, "w") as f:
-                f.write(real_platform)
-
-        def _bail():
-            if sentinel and os.path.exists(sentinel + ".abandoned"):
-                print("bench child: parent abandoned this attempt; "
-                      "exiting", file=sys.stderr)
-                raise SystemExit(4)
-
-        tag = os.environ.get("BENCH_TAG") or real_platform
+        real_platform, tag, _bail = _claim_backend(platform)
         return _divergence_sweep(real_platform, tag, smoke,
                                  reps=3, bail=_bail,
                                  marshals=marshals, B=sw_B,
                                  doc=sw_doc, cap=sw_cap)
+    tree_ns = _tree_sizes()
+    if tree_ns:
+        # merge-tree mode: REAL replica handles (the fold baseline
+        # needs them), marshalled jax-free BEFORE the backend claim —
+        # tree_fleet_handles builds the base weave with the pure host
+        # weaver precisely so this marshal spends no tunnel time
+        if smoke:
+            t_doc, t_div = 400, 6
+        else:
+            t_doc, t_div = 10_000, int(
+                os.environ.get("BENCH_TREE_DIV", "24"))
+        marshals = []
+        for n in tree_ns:
+            with obs.span("bench.tree.marshal", n=n, doc=t_doc):
+                marshals.append((n, benchgen.tree_fleet_handles(
+                    n, t_doc, t_div, hide_every=8)))
+        real_platform, tag, _bail = _claim_backend(platform)
+        return _tree_bench(real_platform, tag, smoke, reps=3,
+                           bail=_bail, marshals=marshals, doc=t_doc,
+                           div=t_div)
     if smoke:
         B, n_base, n_div, cap, reps = 8, 800, 100, 1024, 3
     else:
@@ -985,9 +1157,9 @@ def main() -> None:
             line = out.splitlines()[-1]
             print(line)
             _export_obs_trace(obs_out)
-            if _sweep_levels():
-                # the sweep child already landed one --kind sweep row
-                # per (level, path); ingesting the summary line as a
+            if _sweep_levels() or _tree_sizes():
+                # the sweep/tree child already landed its own --kind
+                # sweep/tree rows; ingesting the summary line as a
                 # bench row would plant a value-less bench artifact
                 # next to the headline trajectory
                 _print_gap_report(obs_out)

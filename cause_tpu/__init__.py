@@ -128,10 +128,32 @@ def merge(causal1, causal2):
     return causal1.merge(causal2)
 
 
-def merge_all(causal, *more):
-    """Converge a whole fleet of replicas in one pass (N-way node union
-    + one reweave). Equal to folding ``merge``, much faster on the
-    native/jax backends."""
+def merge_all(causal, *more, tree=True):
+    """Converge a whole fleet of replicas into one collection.
+
+    Default shape (>= 4 device-weaver list replicas): the merge
+    reduction tree (``cause_tpu.parallel.tree``) — ceil(log2(n))
+    batched device rounds, level 0 full width, later levels riding
+    the delta-native window path, with per-level convergence digests
+    in the flight recorder. Bit-identical to folding ``merge`` in any
+    order (the weave is a pure function of the node set; pinned in
+    tests/test_merge_tree.py).
+
+    ``tree=False`` — or any fleet outside the tree domain (maps,
+    pure/native weavers, < 4 replicas, PackSpec overflow) — takes the
+    flat path: the N-way node union + ONE reweave (``merge_many``),
+    itself equal to the sequential pairwise fold."""
+    # the weaver guard runs BEFORE the parallel import: pure/native
+    # users must never pay a jax import (let alone backend init) for a
+    # call that lands on merge_many anyway — the attribute check is
+    # free, the package import is not
+    if tree and len(more) >= 3 \
+            and getattr(getattr(causal, "ct", None), "weaver", "") == "jax":
+        from .parallel.tree import merge_all_tree
+
+        routed = merge_all_tree([causal, *more])
+        if routed is not None:
+            return routed
     return causal.merge_many(more)
 
 
@@ -226,6 +248,7 @@ from .sync import (  # noqa: E402
 # cause_tpu never drags jax/mesh machinery into pure-host users.
 _FLEET_EXPORTS = {
     "merge_wave": "cause_tpu.parallel",
+    "merge_tree": "cause_tpu.parallel",
     "FleetSession": "cause_tpu.parallel",
     "WaveResult": "cause_tpu.parallel",
     "WaveBuffers": "cause_tpu.parallel",
@@ -302,6 +325,7 @@ __all__ = [
     "sync_stream",
     "version_vector",
     "merge_wave",
+    "merge_tree",
     "merge_map_wave",
     "FleetSession",
     "is_special",

@@ -41,6 +41,7 @@ __all__ = [
     "batched_pair_lanes",
     "delta_sweep_inputs",
     "fleet_lanes",
+    "tree_fleet_handles",
     "estimate_pair_runs",
     "pair_run_budget",
     "merge_wave_scalar",
@@ -770,6 +771,46 @@ def delta_sweep_inputs(
         "starts": starts,
         "counts": counts,
     }
+
+
+def tree_fleet_handles(n_replicas: int, n_base: int, n_div: int,
+                       hide_every: int = 0) -> list:
+    """``n_replicas`` REAL divergent replica handles of one shared
+    ``n_base``-node CausalList, each extended by its own
+    ``n_div``-op suffix (every ``hide_every``-th suffix op a ``hide``
+    tombstone targeting its predecessor) — the merge-tree benchmarks'
+    and smokes' fleet, as host handles rather than raw lanes, because
+    the tree's A/B baseline (the flat pairwise fold) NEEDS handles to
+    materialize through.
+
+    Deliberately jax-free: the base weave is computed by the PURE host
+    weaver and the trees then evolve to ``weaver="jax"`` (the two
+    weavers are semantics-identical — the pure weaver is the oracle),
+    so harvest/bench marshal this fleet BEFORE the backend claim
+    without spending granted tunnel time or initializing a possibly
+    wedged backend. The first suffix op of every replica is a plain
+    value (a tombstone there would target the shared base tail — the
+    anchor — which is exactly the delta-domain violation the tree
+    falls back to full width for)."""
+    import cause_tpu as c
+    from .collections import clist as c_list
+    from .collections.clist import CausalList
+    from .ids import new_site_id
+
+    base = c.clist().extend([f"w{i}" for i in range(n_base)])
+    base = CausalList(c_list.weave(base.ct))
+    base = CausalList(base.ct.evolve(weaver="jax"))
+    replicas = []
+    for r in range(n_replicas):
+        vals: list = []
+        for i in range(n_div):
+            vals.append(f"r{r}.{i}")
+            if hide_every and i and (i + r) % hide_every == 0:
+                vals.append(c.hide)
+        h = CausalList(base.ct.evolve(site_id=new_site_id()))
+        replicas.append(h.extend(vals[:n_div]) if not hide_every
+                        else h.extend(vals))
+    return replicas
 
 
 def batched_pair_lanes(

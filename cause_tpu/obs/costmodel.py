@@ -63,6 +63,7 @@ __all__ = [
     "wave_cost",
     "costmodel_digest",
     "cost_vs_divergence",
+    "tree_decomposition",
     "gap_report",
     "render_gap",
 ]
@@ -204,7 +205,7 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
               delta_ops: int = 0, full_bag: int = 0,
               poisoned: int = 0, overflow_retries: int = 0,
               semantic: Optional[dict] = None,
-              path: str = "") -> Optional[dict]:
+              path: str = "", level: Optional[int] = None) -> Optional[dict]:
     """Close the open wave window and emit ONE ``wave.cost`` event —
     the per-wave join of cost and divergence:
 
@@ -226,7 +227,11 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
       kernel) or ``"delta"`` (the delta-native window weave). The gap
       report fits a separate cost-vs-divergence curve per path, so a
       sweep stream renders the O(doc) control verdict NEXT TO the
-      delta path's O(delta) verdict instead of mixing them.
+      delta path's O(delta) verdict instead of mixing them;
+    - ``level``: the merge-tree round this wave IS, when the wave is
+      one level of a ``parallel.tree`` reduction — joined with the
+      ``tree.level`` semantic events into the gap report's per-level
+      cost decomposition.
 
     Returns the emitted fields (or None when obs is off / no window).
     """
@@ -264,6 +269,8 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
     }
     if path:
         fields["path"] = str(path)
+    if level is not None:
+        fields["level"] = int(level)
     if tokens is not None:
         fields["tokens"] = int(tokens)
         fields["token_budget"] = int(token_budget)
@@ -446,6 +453,58 @@ def _stage_shares(events: Sequence[dict]) -> List[dict]:
     return out
 
 
+def tree_decomposition(events: Sequence[dict]) -> Optional[dict]:
+    """Per-level cost decomposition of merge-tree convergence runs in
+    one obs stream: join each ``tree.level`` semantic event with the
+    ``wave.cost`` events carrying the same level index (a stream may
+    hold several tree runs; levels aggregate). None when the stream
+    carries no tree levels."""
+    levels: Dict[int, dict] = {}
+    for e in events:
+        if e.get("ev") != "event":
+            continue
+        f = e.get("fields") or {}
+        if e.get("name") == "tree.level":
+            lv = levels.setdefault(int(f.get("level") or 0), {
+                "level": int(f.get("level") or 0), "waves": 0,
+                "pairs": 0, "delta_ops": 0, "dispatches": 0,
+                "wall_ms": 0.0, "paths": set(), "agreed": 0,
+            })
+            lv["waves"] += 1
+            lv["pairs"] += int(f.get("pairs") or 0)
+            lv["delta_ops"] += int(f.get("delta_ops") or 0)
+            lv["dispatches"] += int(f.get("dispatches") or 0)
+            if f.get("path"):
+                lv["paths"].add(str(f["path"]))
+            if f.get("agreed"):
+                lv["agreed"] += 1
+        elif e.get("name") == "wave.cost" and f.get("level") is not None:
+            lv = levels.get(int(f["level"]))
+            if lv is not None:
+                lv["wall_ms"] += float(f.get("wall_ms") or 0.0)
+    if not levels:
+        return None
+    out = []
+    total = sum(lv["wall_ms"] for lv in levels.values()) or 1.0
+    for k in sorted(levels):
+        lv = levels[k]
+        lv["paths"] = "+".join(sorted(lv["paths"])) or "?"
+        lv["wall_ms"] = round(lv["wall_ms"], 3)
+        lv["share"] = round(lv["wall_ms"] / total, 4)
+        out.append(lv)
+    post = [lv for lv in out if lv["level"] > 0]
+    return {
+        "rounds": len(out),
+        "levels": out,
+        "wall_ms": round(sum(lv["wall_ms"] for lv in out), 3),
+        # the tree's acceptance shape: later levels ride the delta
+        # path (inter-level divergence shrinks as subtrees converge)
+        "post_level0_delta_share": round(
+            sum(1 for lv in post if "delta" in lv["paths"])
+            / len(post), 4) if post else None,
+    }
+
+
 def gap_report(rows: Sequence[dict],
                events: Optional[Sequence[dict]] = None,
                target_ms: float = NORTH_STAR_MS,
@@ -512,6 +571,9 @@ def gap_report(rows: Sequence[dict],
     stages = _stage_shares(events)
     if stages:
         report["stages"] = stages
+    tree = tree_decomposition(events)
+    if tree:
+        report["tree"] = tree
     curve = cost_vs_divergence(waves)
     report["cost_vs_divergence"] = curve
     # per-path curves: when the stream carries waves from more than
@@ -583,6 +645,22 @@ def render_gap(report: dict) -> str:
     for st in report.get("stages", []):
         lines.append(f"  phase {st['stage']}: {st['delta_ms']:g} ms "
                      f"({100 * st['share']:.1f}%)")
+    tree = report.get("tree")
+    if tree:
+        lines.append(
+            f"  merge tree: {tree['rounds']} round(s), "
+            f"{tree['wall_ms']:g} ms total"
+            + (f", post-level-0 delta share "
+               f"{100 * tree['post_level0_delta_share']:.0f}%"
+               if tree.get("post_level0_delta_share") is not None
+               else ""))
+        for lv in tree["levels"]:
+            lines.append(
+                f"    level {lv['level']}: {lv['pairs']} pair(s), "
+                f"{lv['delta_ops']} delta op(s), "
+                f"{lv['dispatches']} dispatch(es), "
+                f"{lv['wall_ms']:g} ms ({100 * lv['share']:.1f}%), "
+                f"path {lv['paths']}")
     def _curve_line(c, label="cost vs divergence"):
         if c.get("verdict") == "insufficient-data":
             return (f"  {label}: insufficient data "

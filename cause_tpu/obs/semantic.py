@@ -52,6 +52,7 @@ __all__ = [
     "sync_applied",
     "sync_full_bag",
     "observe_wave",
+    "observe_tree_level",
     "session_overflow",
     "token_headroom",
     "gc_compacted",
@@ -71,6 +72,7 @@ SEMANTIC_EVENT_PREFIXES = (
     "gc.",
     "collection.",
     "fleet.",
+    "tree.",
 )
 
 
@@ -245,6 +247,68 @@ def observe_wave(uuid: str, digests: Sequence, valid: Sequence,
                 div["site_expected"] = prov["expected"]
                 div["site_got"] = prov["got"]
         core.event("divergence", **div)
+    return fields
+
+
+def observe_tree_level(uuid: str, level: int, digests: Sequence,
+                       valid: Sequence, pairs: int, byes: int = 0,
+                       delta_ops: int = 0, window: int = 0,
+                       path: str = "", dispatches: int = 0,
+                       final: bool = False) -> Optional[dict]:
+    """Record one merge-tree LEVEL's convergence evidence for document
+    ``uuid`` (the hierarchical fleet-convergence rounds of
+    ``parallel.tree``): a ``wave.digest`` event with ``source="tree"``
+    plus a ``tree.level`` event carrying the level's shape
+    (pairs/byes), divergence work (``delta_ops`` window lanes,
+    ``window`` = per-side lane budget), kernel ``path``
+    ("full"/"delta") and dispatch count.
+
+    Unlike :func:`observe_wave`, intermediate levels deliberately run
+    NO staleness aging and mint NO ``divergence`` incidents: mid-tree,
+    each pair converges a *different* subtree, so distinct digests are
+    the expected shape of a converging fleet, not a health incident —
+    ``agreed`` is still reported (a symmetric fleet's levels agree,
+    the CI smoke gates on it). The root level (``final=True``) has one
+    pair whose digest IS the fleet's converged value; callers feed it
+    to the ordinary :func:`observe_wave` monitors if they track the
+    document across convergence calls.
+
+    Returns the ``tree.level`` fields dict (the ``wave.cost`` join
+    summary), or None when obs is off."""
+    if not core.enabled():
+        return None
+    B = len(valid)
+    vals = [int(digests[i]) for i in range(B) if valid[i]]
+    distinct = len(set(vals))
+    agreed = bool(vals) and distinct == 1
+    dig_fields = {
+        "uuid": str(uuid),
+        "source": "tree",
+        "level": int(level),
+        "wave": int(level) + 1,
+        "pairs": B,
+        "valid": len(vals),
+        "distinct": distinct,
+        "agreed": agreed,
+    }
+    core.event("wave.digest", **dig_fields)
+    fields = {
+        "uuid": str(uuid),
+        "level": int(level),
+        "pairs": int(pairs),
+        "byes": int(byes),
+        "delta_ops": int(delta_ops),
+        "window": int(window),
+        "path": str(path),
+        "dispatches": int(dispatches),
+        "distinct": distinct,
+        "agreed": agreed,
+        "final": bool(final),
+    }
+    core.event("tree.level", **fields)
+    core.counter("tree.levels").inc()
+    if final:
+        core.counter("tree.converges").inc()
     return fields
 
 

@@ -10,4 +10,10 @@ from .mesh import (  # noqa: F401
     sharded_merge_weave_v5,
 )
 from .session import FleetSession  # noqa: F401
+from .tree import (  # noqa: F401
+    flat_fold,
+    merge_tree,
+    merge_tree_report,
+    tree_rounds,
+)
 from .wave import WaveResult, WaveBuffers, merge_wave  # noqa: F401

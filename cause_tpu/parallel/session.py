@@ -37,6 +37,13 @@ update-level fallback run the full kernel and re-establish.
 
 This is the TPU-native sync-fleet loop: edit replicas on host, ship
 deltas, weave ONLY the deltas on device, read digests.
+
+**Fleet convergence (PR 8).** Waves converge PAIRS; bringing the whole
+resident fleet to one state is ``converge()``, which routes through
+the merge reduction tree (``parallel.tree``): ceil(log2(n)) batched
+device rounds instead of the n-1 sequential pairwise waves of the
+flat fold (retained behind ``converge(tree=False)`` as the A/B
+control).
 """
 
 from __future__ import annotations
@@ -656,6 +663,29 @@ class FleetSession:
             self._last_delta_lanes = 0
             self._last_update_full = False
         return out
+
+    def converge(self, tree: bool = True,
+                 w_budget: Optional[int] = None):
+        """Converge the WHOLE resident fleet — every replica of every
+        pair — into one host handle.
+
+        The session's waves converge pairs; fleet-wide convergence is
+        a reduction over all 2B replicas, and its default shape is the
+        merge reduction tree (``parallel.tree``): ceil(log2(2B))
+        batched device rounds, level 0 full width, later levels riding
+        the delta window path, bit-identical to any pairwise fold.
+        ``tree=False`` runs that flat fold instead — n-1 SEQUENTIAL
+        pairwise waves with per-step host materialization, the O(n)
+        baseline the tree replaces (kept as the A/B control and the
+        escape hatch). The session's device-resident pair state is
+        untouched either way: convergence reads the handles, it does
+        not re-upload them."""
+        from . import tree as _tree
+
+        replicas = [h for pair in self.pairs for h in pair]
+        if tree:
+            return _tree.merge_tree(replicas, w_budget=w_budget)
+        return _tree.flat_fold(replicas)
 
     def merged(self, i: int):
         """Materialize pair ``i``'s converged tree (host handle) from

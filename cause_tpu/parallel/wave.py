@@ -41,7 +41,8 @@ from ..weaver.arrays import I32_MAX, next_pow2
 from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
 
 __all__ = ["merge_wave", "WaveResult", "WaveBuffers",
-           "delta_domain_ok", "assemble_delta_window"]
+           "delta_domain_ok", "assemble_delta_window",
+           "dispatch_full_rows"]
 
 
 @lru_cache(maxsize=8)
@@ -196,6 +197,59 @@ def assemble_delta_window(views, s_arr, anchor_arr, wcap: int,
              "seg": seg}
     lanes.update(tables)
     return lanes, starts, counts
+
+
+def dispatch_full_rows(lanes, site: str = "tree"):
+    """One fused full-width kernel+digest dispatch over an assembled
+    ``[B, 2*cap]`` v5 lane batch (``benchgen.LANE_KEYS5`` dict), with
+    the pow2-quantized token budget and a doubled-budget retry for
+    spiky unsampled rows — the level primitive the merge reduction
+    tree (``parallel.tree``) shares with the sweep/harvest gates.
+
+    Returns ``(rank, visible, digest, info)`` as numpy arrays plus an
+    ``info`` dict (``u_need``/``u_max``/``retried`` — the caller's
+    ``wave.cost`` evidence). Raises ``CausalError`` if a row still
+    overflows at the doubled budget (unlike ``merge_wave`` there is no
+    per-pair host fallback here: the caller owns the batch)."""
+    from ..benchgen import LANE_KEYS5, v5_token_budget
+    from ..weaver.jaxwd import batched_weave_digest
+
+    u_need = int(v5_token_budget(lanes))
+    u_max = next_pow2(u_need)
+
+    def _run(sub, u):
+        out = batched_weave_digest(
+            *(jnp.asarray(sub[k]) for k in LANE_KEYS5),
+            u_max=int(u), k_max=int(u))
+        if obs.enabled():
+            from ..obs import costmodel as _cm
+
+            _cm.record_dispatch(f"{site}:full:u{int(u)}", site=site)
+        return tuple(np.asarray(x) for x in out)
+
+    rank, visible, digest, overflow = _run(lanes, u_max)
+    retried = 0
+    if overflow.any():
+        rows = np.flatnonzero(overflow)
+        retried = len(rows)
+        obs.counter("wave.overflow_retry").inc(retried)
+        sub = {k: lanes[k][rows] for k in LANE_KEYS5}
+        r2, v2, d2, ov2 = _run(sub, 2 * u_max)
+        if ov2.any():
+            raise s.CausalError(
+                "full-width level overflowed its doubled token budget",
+                {"causes": {"token-overflow"},
+                 "rows": np.flatnonzero(ov2).tolist()},
+            )
+        rank = np.array(rank)
+        visible = np.array(visible)
+        digest = np.array(digest)
+        rank[rows] = r2
+        visible[rows] = v2
+        digest[rows] = d2
+    return rank, visible, digest, {
+        "u_need": u_need, "u_max": int(u_max), "retried": retried,
+    }
 
 
 def _observe_semantics(pairs, digests, valid, source: str):

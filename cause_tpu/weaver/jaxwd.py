@@ -44,6 +44,16 @@ overflow left is the window *capacity* itself (divergence outgrowing
 the session's pow2 window budget), which falls back to a full-width
 rebuild — the "first contact or budget overflow" policy of ROADMAP
 item 1.
+
+Consumers (PR 8): beyond the steady-state ``FleetSession`` wave, the
+merge reduction tree (``parallel.tree``) batches each of its
+ceil(log2(n)) fleet-convergence levels as ONE ``batched_delta_weave``
+dispatch — per pair the two "trees" are pooled subtree sides under
+the shared anchor, and the returned digest is each merged subtree's
+TOTAL document digest, so per-level convergence evidence costs no
+extra dispatch. ``batched_weave_digest`` is the tree's full-width
+level (first contact / window-budget bounce) and the sweep/harvest
+control arm.
 """
 
 from __future__ import annotations

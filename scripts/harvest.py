@@ -272,6 +272,33 @@ def main() -> None:
          ms=round((time.monotonic() - t0) * 1000, 1),
          wcap_low=dsw_low["wcap"], wcap_high=dsw_high["wcap"])
 
+    # merge-tree fleet (PR 8), still pre-claim: REAL replica handles
+    # (the flat-fold baseline must materialize through them) built
+    # entirely jax-free — tree_fleet_handles weaves the shared base
+    # with the PURE host weaver, so this marshal spends no granted
+    # tunnel time and cannot init a wedged backend. ~10 s of host
+    # Python, so a resumed run whose tree items are already done
+    # skips it (the lazy fallback below covers any state drift).
+    if a.smoke:
+        TREE_N, TREE_NB, TREE_ND = 8, 400, 6
+    else:
+        TREE_N, TREE_NB, TREE_ND = 64, 10_000, 24
+    _tree_fleet_cache: list = []
+
+    def tree_fleet():
+        if not _tree_fleet_cache:
+            t0 = time.monotonic()
+            _tree_fleet_cache.append(benchgen.tree_fleet_handles(
+                TREE_N, TREE_NB, TREE_ND, hide_every=8))
+            emit(ev="marshal_tree",
+                 ms=round((time.monotonic() - t0) * 1000, 1),
+                 replicas=TREE_N, doc=1 + TREE_NB + TREE_ND)
+        return _tree_fleet_cache[0]
+
+    _done_preview, _ = load_state()
+    if not {"verify_tree", "bench_tree"} <= _done_preview:
+        tree_fleet()  # pre-claim build (window economy)
+
     # Bounded backend claim (shared guard; see claimguard docstring):
     # hard-exit if the tunnel claim wedges past HARVEST_CLAIM_DEADLINE,
     # disarmed before any compile can be in flight.
@@ -944,6 +971,91 @@ def main() -> None:
             done.add(name)
             save_state(done, results)
 
+    def verify_tree_item(name):
+        """Bit-identity gate for the merge reduction tree (PR 8): the
+        TREE_N-replica fleet converged through ``parallel.tree``
+        (ceil(log2(n)) batched device rounds) must equal the flat
+        sequential pairwise fold bit-for-bit — weave AND node store —
+        with the round count the tree promises. Both arms run on this
+        chip's own lowering; the wall times ride the record so
+        bench_tree can reuse the fold arm instead of paying the n-1
+        sequential waves a second time (window economy)."""
+        from cause_tpu.parallel import tree as tree_mod
+
+        fleet = tree_fleet()
+        t0 = time.perf_counter()
+        root, rep = tree_mod.merge_tree_report(fleet)
+        tree_ms = (time.perf_counter() - t0) * 1000
+        t1 = time.perf_counter()
+        fold = tree_mod.flat_fold(fleet)
+        fold_ms = (time.perf_counter() - t1) * 1000
+        rounds_expected = tree_mod.tree_rounds(len(fleet))
+        rounds_ok = len(rep["levels"]) == rounds_expected
+        ok = (rounds_ok and root.ct.weave == fold.ct.weave
+              and root.ct.nodes == fold.ct.nodes)
+        rec = dict(item=name, verdict="MATCH" if ok else "MISMATCH",
+                   replicas=len(fleet),
+                   rounds=len(rep["levels"]),
+                   rounds_expected=rounds_expected,
+                   paths=[lv["path"] for lv in rep["levels"]],
+                   tree_ms=round(tree_ms, 1), fold_ms=round(fold_ms, 1),
+                   shape=f"{TREE_N}x{1 + TREE_NB + TREE_ND}",
+                   platform=plat, run=RUN_ID)
+        emit(ev="result", **rec)
+        # in-memory always (bench_tree reads the same-window verdict
+        # even on CPU/smoke rehearsals); persisted only for real
+        # full-size windows like every other item
+        results[name] = rec
+        if record_state:
+            if ok:
+                done.add(name)
+            save_state(done, results)
+
+    def bench_tree_item(name):
+        """bench.py-methodology timing of merge-tree fleet convergence
+        vs the flat fold. The tree arm re-measures (reps); the fold arm
+        — n-1 SEQUENTIAL full-width waves, minutes of window — reuses
+        verify_tree's same-window measurement when one exists and runs
+        once otherwise."""
+        from cause_tpu.parallel import tree as tree_mod
+
+        vrec = results.get("verify_tree") or {}
+        if vrec.get("verdict") != "MATCH":
+            emit(ev="skip", item=name,
+                 reason="no MATCH verify_tree on record; not timing an "
+                        "unverified reduction")
+            return
+        fleet = tree_fleet()
+        singles = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            tree_mod.merge_tree(fleet)
+            singles.append((time.perf_counter() - t0) * 1000)
+        if vrec.get("run") == RUN_ID and vrec.get("fold_ms"):
+            fold_ms = float(vrec["fold_ms"])
+            fold_src = "verify_tree (same window)"
+        else:
+            t0 = time.perf_counter()
+            tree_mod.flat_fold(fleet)
+            fold_ms = (time.perf_counter() - t0) * 1000
+            fold_src = "measured"
+        p50 = float(np.median(singles))
+        rec = dict(item=name, kernel="v5t", config="merge-tree",
+                   cfg={},
+                   p50_tree_ms=round(p50, 1),
+                   singles_ms=[round(x, 1) for x in singles],
+                   fold_ms=round(fold_ms, 1), fold_source=fold_src,
+                   tree_over_fold=round(p50 / max(fold_ms, 1e-9), 4),
+                   rounds=vrec.get("rounds"),
+                   replicas=len(fleet), platform=plat,
+                   shape=f"{TREE_N}x{1 + TREE_NB + TREE_ND}",
+                   run=RUN_ID)
+        emit(ev="result", **rec)
+        if record_state:
+            results[name] = rec
+            done.add(name)
+            save_state(done, results)
+
     # ---- the ladder, highest information value per second first -----
     # Round-5 order after window 1: the XLA-only streaming family is
     # the only measurable candidate on this tunnel (Mosaic compiles
@@ -975,6 +1087,12 @@ def main() -> None:
          ("bench_delta_high", dsw_high, ND)),
         ("bench_delta_low", delta_bench_item,
          ("bench_delta_low", dsw_low, ND_LOW)),
+        # merge reduction tree (PR 8), right after the delta items so
+        # the FIRST tunnel window certifies the still-pending delta
+        # weave AND the O(log n) tree in one claim: the bit-identity
+        # gate (tree vs flat fold at B=64), then the timing A/B
+        ("verify_tree", verify_tree_item, ("verify_tree",)),
+        ("bench_tree", bench_tree_item, ("bench_tree",)),
         ("bench_rowgather", bench_item,
          ("bench_rowgather", "v5", cfg_of(CAUSE_TPU_GATHER="rowgather"))),
         ("bench_matrix", bench_item,

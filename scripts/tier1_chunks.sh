@@ -11,15 +11,25 @@
 # prints one merged DOTS_PASSED total at the end — the same contract
 # the monolithic command's final line carries.
 #
+# File selection is the `ls tests/test_*.py` glob below — NEW test
+# files (e.g. tests/test_merge_tree.py) are picked up automatically
+# with no edit here; only a file living outside tests/ or not named
+# test_*.py would be missed.
+#
 # Usage: bash scripts/tier1_chunks.sh [N_CHUNKS]
-#   N_CHUNKS             number of chunks (default 4)
+#   N_CHUNKS             chunk count — positional arg, else the
+#                        TIER1_CHUNKS env var, else 4. More chunks =
+#                        shorter per-chunk wall time (each gets the
+#                        full TIER1_CHUNK_TIMEOUT) but more repeated
+#                        per-chunk jax import/compile overhead; 4-6
+#                        fits this container's ~1.5 cpu-shares.
 #   TIER1_CHUNK_TIMEOUT  per-chunk wall cap in seconds (default 870)
 #
 # Exit: non-zero if any chunk failed tests or timed out; chunks keep
 # running after a failure so the merged dot total stays comparable.
 set -u -o pipefail
 
-N=${1:-4}
+N=${1:-${TIER1_CHUNKS:-4}}
 PER_CHUNK_TIMEOUT=${TIER1_CHUNK_TIMEOUT:-870}
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,311 @@
+"""The hierarchical merge reduction tree (PR 8): fleet convergence in
+ceil(log2(n)) batched device rounds, bit-identical to the flat
+pairwise fold.
+
+Pins the tentpole contract:
+
+- bit-identity at multiple shapes — odd replica counts (bye lanes),
+  tombstoned suffixes, duplicated replicas (window twin dedupe),
+  degenerate n=1/n=2 trees — against folding ``merge`` in input order;
+- ``merge_all`` routes >=4 device-weaver list replicas through the
+  tree (flat ``merge_many`` retained behind ``tree=False`` and for
+  pure-weaver / small fleets), result identical either way;
+- a mid-tree full-width bounce (window outgrowing ``w_budget``, the
+  pow2-growth analogue of the session's re-upload bounce) does not
+  corrupt later levels;
+- per-level observability: ``tree.level`` + ``wave.digest`` with
+  ``source="tree"`` per level, per-level ``wave.cost`` joins with the
+  round index, level count == ceil(log2(n)), post-level-0 levels ride
+  the delta path, and ``obs gap``'s tree decomposition renders;
+- obs-off invariance: identical convergence with zero records;
+- ``FleetSession.converge`` delegates to the tree (flat fold behind
+  ``tree=False``) without disturbing the resident wave state.
+"""
+
+import functools
+
+import numpy as np
+import pytest
+
+import cause_tpu as c
+from cause_tpu import obs
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id
+from cause_tpu.obs import costmodel, semantic
+from cause_tpu.parallel import tree as tree_mod
+from cause_tpu.parallel.session import FleetSession
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    semantic.reset()
+    costmodel.reset()
+    yield
+    obs.configure(enabled=False)
+    obs.reset()
+    semantic.reset()
+    costmodel.reset()
+
+
+def warm(cl):
+    return CausalList(c_list.weave(cl.ct))
+
+
+def make_base(n=40):
+    base = warm(c.clist(weaver="jax").extend(
+        [f"w{i}" for i in range(n)]
+    ))
+    base.ct.lanes.segments()
+    return base
+
+
+def make_fleet(base, n, n_div=4, hide_every=0):
+    fleet = []
+    for r in range(n):
+        h = CausalList(base.ct.evolve(site_id=new_site_id()))
+        for i in range(n_div):
+            h = h.conj(f"r{r}.{i}")
+            if hide_every and i and i % hide_every == 0:
+                h = h.conj(c.hide)
+        fleet.append(h)
+    return fleet
+
+
+def fold(handles):
+    return functools.reduce(lambda a, b: a.merge(b), handles)
+
+
+def assert_identical(got, want):
+    assert got.ct.nodes == want.ct.nodes
+    assert got.ct.weave == want.ct.weave
+    assert got.ct.lamport_ts == want.ct.lamport_ts
+
+
+# ------------------------------------------------------- bit identity
+
+
+@pytest.mark.parametrize("n,n_div,hide_every", [
+    (4, 3, 0),
+    (5, 4, 0),    # odd: a bye lane at level 0
+    (7, 2, 2),    # odd twice (7 -> 4 -> 2 -> 1), tombstoned suffixes
+    (8, 5, 3),
+])
+def test_tree_bit_identical_to_fold(n, n_div, hide_every):
+    base = make_base()
+    fleet = make_fleet(base, n, n_div=n_div, hide_every=hide_every)
+    root, rep = tree_mod.merge_tree_report(fleet)
+    assert_identical(root, fold(fleet))
+    assert len(rep["levels"]) == rep["rounds"] == tree_mod.tree_rounds(n)
+    # level 0 establishes; later levels ride the delta window path
+    assert rep["levels"][0]["path"] == "full"
+    assert all(lv["path"] == "delta" for lv in rep["levels"][1:])
+
+
+def test_tree_rounds_arithmetic():
+    assert tree_mod.tree_rounds(1) == 0
+    assert tree_mod.tree_rounds(2) == 1
+    assert tree_mod.tree_rounds(3) == 2
+    assert tree_mod.tree_rounds(5) == 3
+    assert tree_mod.tree_rounds(64) == 6
+    assert tree_mod.tree_rounds(1024) == 10
+
+
+def test_degenerate_trees():
+    base = make_base()
+    a, b = make_fleet(base, 2, n_div=3)
+    # n=1: the tree IS the input
+    root, rep = tree_mod.merge_tree_report([a])
+    assert root is a and rep["rounds"] == 0 and rep["levels"] == []
+    # n=2: one full-width level, no delta rounds
+    root, rep = tree_mod.merge_tree_report([a, b])
+    assert_identical(root, a.merge(b))
+    assert [lv["path"] for lv in rep["levels"]] == ["full"]
+    # n=3: bye at level 0, delta root round
+    root, rep = tree_mod.merge_tree_report([a, b, a])
+    assert_identical(root, a.merge(b))
+    assert rep["levels"][0]["byes"] == 1
+    assert len(rep["levels"]) == 2
+
+
+def test_duplicated_replicas_dedupe_in_windows():
+    """A symmetric fleet ([a, b] repeated) pools identical sides at
+    every post-0 level — the window twin dedupe must collapse them and
+    every level must agree."""
+    base = make_base()
+    a, b = make_fleet(base, 2, n_div=3)
+    root, rep = tree_mod.merge_tree_report([a, b] * 8)
+    assert_identical(root, a.merge(b))
+    assert all(lv["agreed"] for lv in rep["levels"])
+    assert len(rep["levels"]) == 4
+
+
+def test_flat_fold_equals_merge_fold():
+    base = make_base()
+    fleet = make_fleet(base, 5, n_div=3)
+    assert_identical(tree_mod.flat_fold(fleet), fold(fleet))
+
+
+# ------------------------------------------------------ merge_all API
+
+
+def test_merge_all_routes_through_tree():
+    base = make_base()
+    fleet = make_fleet(base, 6, n_div=3, hide_every=2)
+    want = fold(fleet)
+    obs.configure(enabled=True)
+    via_tree = c.merge_all(fleet[0], *fleet[1:])
+    tl = [e for e in obs.events() if e.get("ev") == "event"
+          and e.get("name") == "tree.level"]
+    assert tl, "merge_all did not route through the tree"
+    obs.configure(enabled=False)
+    assert_identical(via_tree, want)
+    # the flat path stays behind tree=False, same result
+    obs.reset()
+    obs.configure(enabled=True)
+    via_flat = c.merge_all(fleet[0], *fleet[1:], tree=False)
+    tl = [e for e in obs.events() if e.get("ev") == "event"
+          and e.get("name") == "tree.level"]
+    assert not tl, "tree=False must not route through the tree"
+    obs.configure(enabled=False)
+    assert via_flat.ct.nodes == want.ct.nodes
+    assert via_flat.ct.weave == want.ct.weave
+
+
+def test_merge_all_small_and_pure_fleets_stay_flat():
+    base = make_base()
+    a, b, x = make_fleet(base, 3, n_div=2)
+    obs.configure(enabled=True)
+    out = c.merge_all(a, b, x)  # < 4 inputs: merge_many
+    tl = [e for e in obs.events() if e.get("ev") == "event"
+          and e.get("name") == "tree.level"]
+    assert not tl
+    obs.configure(enabled=False)
+    assert out.ct.nodes == fold([a, b, x]).ct.nodes
+    # pure-weaver handles never touch the device path
+    pbase = warm(c.clist().extend(["p"] * 12))
+    pf = [CausalList(pbase.ct.evolve(site_id=new_site_id())).conj(f"x{r}")
+          for r in range(5)]
+    out = c.merge_all(pf[0], *pf[1:])
+    assert out.ct.nodes == fold(pf).ct.nodes
+    assert out.ct.weave == fold(pf).ct.weave
+
+
+# ------------------------------------------------- mid-tree full bounce
+
+
+def test_mid_tree_bounce_does_not_corrupt_later_levels():
+    """Pooled windows outgrowing w_budget bounce that level (and, the
+    windows only growing up the tree, the levels after it) to full
+    document width — the result must stay bit-identical and the
+    remaining rounds must still run."""
+    base = make_base()
+    fleet = make_fleet(base, 16, n_div=2)
+    root, rep = tree_mod.merge_tree_report(fleet, w_budget=9)
+    assert_identical(root, fold(fleet))
+    paths = [lv["path"] for lv in rep["levels"]]
+    assert len(paths) == 4
+    assert "delta" in paths[1:], paths      # delta engaged before the
+    assert "full" in paths[1:], paths       # bounce, full after it
+    # tiny budget: every level bounces, result still exact
+    root2, rep2 = tree_mod.merge_tree_report(fleet, w_budget=2)
+    assert_identical(root2, fold(fleet))
+    assert all(lv["path"] == "full" for lv in rep2["levels"])
+
+
+# ----------------------------------------------------- observability
+
+
+def test_tree_level_events_and_gap_join():
+    base = make_base()
+    fleet = make_fleet(base, 8, n_div=3)
+    obs.configure(enabled=True)
+    root, rep = tree_mod.merge_tree_report(fleet)
+    evs = obs.events()
+    obs.configure(enabled=False)
+    assert_identical(root, fold(fleet))
+
+    tl = [e["fields"] for e in evs if e.get("ev") == "event"
+          and e.get("name") == "tree.level"]
+    wd = [e["fields"] for e in evs if e.get("ev") == "event"
+          and e.get("name") == "wave.digest"
+          and e["fields"].get("source") == "tree"]
+    wc = [e["fields"] for e in evs if e.get("ev") == "event"
+          and e.get("name") == "wave.cost"
+          and e["fields"].get("source") == "tree"]
+    div = [e for e in evs if e.get("ev") == "event"
+           and e.get("name") == "divergence"]
+    assert not div, "mid-tree distinct subtrees must not mint incidents"
+    rounds = tree_mod.tree_rounds(8)
+    assert len(tl) == len(wd) == len(wc) == rounds
+    assert sorted(f["level"] for f in tl) == list(range(rounds))
+    assert [f["level"] for f in wc] == list(range(rounds))
+    # level 0 full, the rest delta — and >= half of post-0 is delta
+    assert wc[0]["path"] == "full"
+    post = [f["path"] for f in wc[1:]]
+    assert sum(1 for p in post if p == "delta") >= len(post) / 2
+    assert all(f["dispatches"] >= 1 for f in wc)
+    assert all(f["delta_ops"] > 0 for f in wc[1:])
+    assert tl[-1]["final"] is True
+
+    # the gap report's per-level decomposition
+    dec = costmodel.tree_decomposition(evs)
+    assert dec is not None and dec["rounds"] == rounds
+    assert dec["post_level0_delta_share"] == 1.0
+    assert all(lv["wall_ms"] > 0 for lv in dec["levels"])
+    rep_dict = costmodel.gap_report([], evs)
+    assert rep_dict["tree"]["rounds"] == rounds
+    rendered = costmodel.render_gap(rep_dict)
+    assert "merge tree" in rendered and "level 0" in rendered
+
+
+def test_obs_off_invariance():
+    base = make_base()
+    fleet = make_fleet(base, 6, n_div=3)
+    assert not obs.enabled()
+    root, rep = tree_mod.merge_tree_report(fleet)
+    assert obs.events() == []
+    # no semantic monitor state, no cost-model state
+    assert costmodel._PROGRAMS == {} and costmodel._PENDING_OPS == {}
+    assert semantic._MON == {}
+    # identical routing decisions with obs on
+    obs.configure(enabled=True)
+    root_on, rep_on = tree_mod.merge_tree_report(fleet)
+    obs.configure(enabled=False)
+    assert_identical(root, root_on)
+    assert [lv["path"] for lv in rep["levels"]] == \
+        [lv["path"] for lv in rep_on["levels"]]
+
+
+# -------------------------------------------------- session converge
+
+
+def test_session_converge_tree_and_fold():
+    base = make_base()
+    fleet = make_fleet(base, 4, n_div=3)
+    pairs = [(fleet[0], fleet[1]), (fleet[2], fleet[3])]
+    sess = FleetSession(pairs)
+    sess.wave()
+    want = fold(fleet)
+    assert_identical(sess.converge(), want)
+    got_flat = sess.converge(tree=False)
+    assert got_flat.ct.nodes == want.ct.nodes
+    assert got_flat.ct.weave == want.ct.weave
+    # the resident wave state survives convergence
+    d = sess.wave()
+    assert d.shape == (2,)
+
+
+# ---------------------------------------------------- generator twin
+
+
+def test_tree_fleet_handles_generator():
+    from cause_tpu import benchgen
+
+    fleet = benchgen.tree_fleet_handles(5, 30, 4, hide_every=2)
+    assert len(fleet) == 5
+    assert all(h.ct.weaver == "jax" for h in fleet)
+    root, rep = tree_mod.merge_tree_report(fleet)
+    assert_identical(root, fold(fleet))
+    assert len(rep["levels"]) == tree_mod.tree_rounds(5)
