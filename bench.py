@@ -219,6 +219,137 @@ def _tree_sizes() -> list:
     return sizes
 
 
+def _lag_sizes() -> list:
+    """Parse BENCH_LAG ("64" or "64,256": replica counts per fleet).
+    Empty when the convergence-lag bench mode is off."""
+    raw = os.environ.get("BENCH_LAG", "").strip()
+    if not raw:
+        return []
+    try:
+        sizes = sorted({int(x) for x in raw.split(",") if x.strip()})
+    except ValueError:
+        raise SystemExit(f"bench: BENCH_LAG must be a comma-separated "
+                         f"list of replica counts; got {raw!r}")
+    if any(n < 2 or n % 2 for n in sizes):
+        raise SystemExit("bench: BENCH_LAG fleets need an even replica "
+                         "count >= 2 (replicas pair up in the session)")
+    return sizes
+
+
+def _lag_bench(real_platform: str, tag: str, smoke: bool, rounds: int,
+               bail, marshals: list, doc: int, div: int,
+               slo_ms: float) -> dict:
+    """The convergence-lag bench (BENCH_LAG): run each fleet of REAL
+    replica handles as a FleetSession — one conj per replica per round,
+    ship deltas, wave — with the ``obs.lag`` tracer resolving every
+    op's create→woven / create→converged latency against the wave
+    digest agreement the session already emits. The warm phase
+    (compile spikes + pow2-growth bounces) runs obs-OFF and the lag
+    registry is reset before measurement, so the committed curve is
+    steady-state rounds only. Lands one ``--kind lag`` ledger row per
+    fleet (value = converged p99 ms — wall-gated only inside tpu
+    partitions, like every row) and streams ``op.lag`` / ``lag.window``
+    into the sidecar for the ``obs lag`` CLI."""
+    from cause_tpu.obs import lag as lag_mod
+    from cause_tpu.parallel.session import FleetSession
+
+    rows = []
+    for n, handles in marshals:
+        bail()
+        # a SYMMETRIC fleet (every row the same divergent replica
+        # pair, the CI-smoke shape): fleet-convergence — the lag
+        # tracer's resolution point — means every row's digest agrees,
+        # which asymmetric per-row edits would structurally preclude
+        a0, b0 = handles[0], handles[1]
+        pairs = [(a0, b0)] * (n // 2)
+        obs_was_on = obs.enabled()
+        if obs_was_on:
+            obs.configure(enabled=False)
+        with obs.span("bench.lag.warm", n=n):
+            sess = FleetSession(pairs)
+            sess.wave()
+            for w in range(2):
+                sess.update([(a.conj(f"warm{w}"), b.conj(f"warm{w}b"))
+                             for a, b in sess.pairs])
+                sess.wave()
+        if obs_was_on:
+            obs.configure(enabled=True)
+        # marshal + warm stamped thousands of ops with compile-time
+        # lags; the measured distribution is steady-state rounds only.
+        # The epoch scopes this fleet's summary to ITS OWN records:
+        # lag_summary deliberately sums across reset epochs (the
+        # multi-stream read-side rule), so an unscoped read would fold
+        # every earlier fleet into this row — and positional ring
+        # slicing would misalign once the bounded ring wraps
+        lag_mod.reset()
+        lag_mod.set_slo(slo_ms)
+        fleet_epoch = lag_mod.current_epoch()
+
+        # measured block: steady-state wave rounds ONLY — the signal
+        # an admission controller batches against. A closing tree
+        # converge() was tried and rejected: its per-level programs
+        # are pow2-bucketed in the ACCUMULATED divergence, so any
+        # warm-phase converge runs at a different bucket and the
+        # measured one recompiles — a compile spike masquerading as
+        # convergence lag. The tree resolution path is evidenced by
+        # tests/test_lag.py, the soak's wave_round converge, and the
+        # CI smokes instead.
+        for r in range(rounds):
+            bail()
+            sess.update([(a.conj(f"r{r}"), b.conj(f"q{r}"))
+                         for a, b in sess.pairs])
+            sess.wave()
+        summary = lag_mod.lag_summary(obs.events(), epoch=fleet_epoch)
+        conv = summary["converged"]
+        slo = summary["slo"]
+        row = {
+            "replicas": n, "doc": doc + 1, "div_ops": div,
+            "rounds": rounds,
+            "ops_converged": summary["ops_converged"],
+            "pending": summary["pending"],
+            "p50_ms": conv["p50_ms"], "p95_ms": conv["p95_ms"],
+            "p99_ms": conv["p99_ms"], "max_ms": conv["max_ms"],
+            "slo_ms": slo["target_ms"],
+            "attainment": slo["attainment"],
+            "verdict": slo["verdict"],
+        }
+        rows.append(row)
+        print(f"bench: lag n={n}: {summary['ops_converged']} ops over "
+              f"{rounds} round(s), p50 {conv['p50_ms']} ms / p99 "
+              f"{conv['p99_ms']} ms, SLO {slo['target_ms']:g} ms -> "
+              f"{slo['verdict']}", file=sys.stderr)
+        try:
+            from cause_tpu.obs import ledger
+
+            ledger.ingest_record(
+                {"platform": tag or real_platform,
+                 "metric": f"op convergence lag p99, {n} replicas x "
+                           f"{doc + 1}-node CausalLists",
+                 "value": conv["p99_ms"],
+                 "kernel": "session",
+                 "config": f"n{n}-lag",
+                 "schema_version": BENCH_SCHEMA_VERSION},
+                source=f"bench-lag@{time.strftime('%Y-%m-%d')}",
+                kind="lag",
+                extra={"lag": row})
+        except Exception as e:  # noqa: BLE001 - best-effort rows
+            print(f"bench: lag ledger append failed ({e})",
+                  file=sys.stderr)
+    obs.flush()
+    return {
+        "metric": f"per-op convergence lag over FleetSession rounds, "
+                  f"{doc + 1}-node CausalLists"
+                  + (" [smoke size]" if smoke else ""),
+        "value": None,
+        "unit": "ms",
+        "fleets": rows,
+        "slo_ms": slo_ms,
+        "vs_baseline": 0.0,
+        "platform": tag or real_platform,
+        "schema_version": BENCH_SCHEMA_VERSION,
+    }
+
+
 def _tree_bench(real_platform: str, tag: str, smoke: bool, reps: int,
                 bail, marshals: list, doc: int, div: int) -> dict:
     """The merge-tree bench (BENCH_TREE): converge each fleet of REAL
@@ -702,6 +833,43 @@ def measure(platform: str) -> dict:
         return _tree_bench(real_platform, tag, smoke, reps=3,
                            bail=_bail, marshals=marshals, doc=t_doc,
                            div=t_div)
+    lag_ns = _lag_sizes()
+    if lag_ns:
+        if not obs.enabled():
+            # the lag metric is entirely obs-derived: without obs the
+            # mode would pay the full marshal + measured rounds and
+            # land a null-value row — fail loudly like a malformed
+            # BENCH_LAG instead
+            raise SystemExit("bench: BENCH_LAG requires CAUSE_TPU_OBS=1 "
+                             "(the lag metric is computed from the obs "
+                             "event stream)")
+        # convergence-lag mode: REAL replica handles paired into a
+        # FleetSession, marshalled jax-free BEFORE the backend claim
+        # (same window-economy rule as the tree mode)
+        if smoke:
+            l_doc, l_div, l_rounds = 200, 4, 4
+        else:
+            # 960 keeps the document + every appended suffix inside
+            # the 1024-lane pow2 capacity bucket: a doc minted at the
+            # cliff would force a mid-measurement full re-upload and
+            # recompile the session programs on the measured curve
+            l_doc = int(os.environ.get("BENCH_LAG_DOC", "960"))
+            l_div = 8
+            l_rounds = int(os.environ.get("BENCH_LAG_ROUNDS", "8"))
+        marshals = []
+        for n in lag_ns:
+            # two divergent replicas suffice: the session fleet is the
+            # same pair replicated across n/2 rows (symmetric fleet —
+            # see _lag_bench)
+            with obs.span("bench.lag.marshal", n=n, doc=l_doc):
+                marshals.append((n, benchgen.tree_fleet_handles(
+                    2, l_doc, l_div, hide_every=8)))
+        real_platform, tag, _bail = _claim_backend(platform)
+        return _lag_bench(real_platform, tag, smoke, rounds=l_rounds,
+                          bail=_bail, marshals=marshals, doc=l_doc,
+                          div=l_div,
+                          slo_ms=float(os.environ.get(
+                              "BENCH_LAG_SLO_MS", "") or 100.0))
     if smoke:
         B, n_base, n_div, cap, reps = 8, 800, 100, 1024, 3
     else:

@@ -713,6 +713,43 @@ def check_obs005(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                     "(or hoist it off the traced path)")
 
 
+_LAG_APIS = frozenset(
+    {"op_created", "ops_applied", "wave_observed", "level_observed",
+     "pending_ops", "lag_summary", "set_slo"}
+)
+
+
+@rule("OBS006",
+      "convergence-lag API reached from jit-reachable code without an "
+      "obs.enabled() guard (the lag tracer takes registry locks, "
+      "stamps wall clocks and assembles per-op records the moment obs "
+      "is on)")
+def check_obs006(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]):
+                # lag.enabled() IS the sanctioned guard
+                continue
+            is_lag = (
+                parts[-1] in _LAG_APIS
+                or any(p in ("lag", "_lag") for p in parts[:-1])
+            )
+            if is_lag and not guarded:
+                yield _finding(
+                    "OBS006", module, call,
+                    f"lag.{parts[-1]}() on a jit-reachable path "
+                    "without an obs.enabled() guard — unlike the "
+                    "no-op span/counter factories, the lag tracer "
+                    "reads monotonic clocks and mutates the bounded "
+                    "op registry when obs is on; gate the call (or "
+                    "hoist it off the traced path)")
+
+
 # ----------------------------------------------------------------- LCA
 
 @rule("LCA001",

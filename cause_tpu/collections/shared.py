@@ -218,6 +218,13 @@ def insert(weave_fn: WeaveFn, ct: CausalTree, node, more_nodes_in_tx=None) -> Ca
                 {"causes": {"cause-must-exist"}},
             )
         seen.add(nd[0])
+    if obs.enabled():
+        # convergence-lag provenance: every local mutation funnels
+        # through here (conj/cons/extend/insert all land on this
+        # validated path), so this is the one host-side stamp point —
+        # site and lamport ride in the node id, the monotonic clock is
+        # captured inside op_created, all outside any trace
+        obs.lag.op_created(ct.uuid, [nd[0] for nd in nodes])
     # a non-chaining same-tx run is the one input whose INCREMENTAL
     # weave (contiguous splice at the run head's cause — the
     # runs-stick-together rule) differs from a from-scratch rebuild

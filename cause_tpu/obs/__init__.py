@@ -23,6 +23,7 @@ from .core import (
 )
 from .perfetto import export_perfetto, load_jsonl, to_chrome_trace
 from . import costmodel
+from . import lag
 from . import semantic
 
 __all__ = [
@@ -37,6 +38,7 @@ __all__ = [
     "export_perfetto",
     "flush",
     "gauge",
+    "lag",
     "load_jsonl",
     "reset",
     "semantic",

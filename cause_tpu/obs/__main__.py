@@ -5,6 +5,7 @@
     python -m cause_tpu.obs ledger --check               # perf ledger
     python -m cause_tpu.obs fleet events.jsonl           # fleet health
     python -m cause_tpu.obs gap [--obs events.jsonl]     # gap report
+    python -m cause_tpu.obs lag events.jsonl             # lag tracer
 
 The default (first) form converts an obs JSONL event stream to a
 Perfetto trace — open the output at https://ui.perfetto.dev (or
@@ -42,6 +43,10 @@ def main(argv=None) -> int:
         from .costmodel import main as gap_main
 
         return gap_main(argv[1:])
+    if argv and argv[0] == "lag":
+        from .lag import main as lag_main
+
+        return lag_main(argv[1:])
     return _convert_main(argv)
 
 

@@ -17,9 +17,17 @@ the CRDT-semantic vocabulary into one operator-facing report —
 - **GC** — compaction runs, nodes examined/reclaimed, safety-valve
   declines;
 - **collections** — lazy-weave materializations and the last
-  tombstone ratio.
+  tombstone ratio;
+- **convergence lag** — the ``obs.lag`` tracer's summary (ops
+  converged, create→converged p50/p99, SLO verdict) when the stream
+  carries ``lag.window`` records; the full distribution and the
+  per-replica worst offenders render through
+  ``python -m cause_tpu.obs lag``.
 
-Counters are merged with the shared per-pid last-snapshot rule
+Multiple JSONL streams (a multi-process soak's per-process sidecars)
+merge by timestamp before aggregation, so "the last wave per
+document" is well-defined across processes. Counters are merged with
+the shared per-pid last-snapshot rule
 (``perfetto.merged_final_counters``), so a sidecar shared by a parent
 and an abandoned child reports the sum, not whichever flushed last.
 Stdlib-only, importable without jax, like the rest of ``cause_tpu.obs``.
@@ -33,9 +41,10 @@ import os
 import sys
 from typing import Dict, Iterable, List
 
-from .perfetto import load_jsonl, merged_final_counters
+from .lag import lag_summary
+from .perfetto import load_streams, merged_final_counters
 
-__all__ = ["fleet_report", "render", "main"]
+__all__ = ["fleet_report", "render", "load_streams", "main"]
 
 
 def _events_named(events: Iterable[dict], name: str) -> List[dict]:
@@ -132,6 +141,21 @@ def fleet_report(events: List[dict]) -> dict:
             "lazy_materializations":
                 counters.get("collection.lazy_materialize", 0),
         },
+        "lag": _lag_section(events),
+    }
+
+
+def _lag_section(events: List[dict]) -> dict:
+    """The compact convergence-lag block of the fleet report (the full
+    distribution lives in ``python -m cause_tpu.obs lag``)."""
+    rep = lag_summary(events)
+    conv = rep["converged"]
+    return {
+        "ops_converged": rep["ops_converged"],
+        "pending": rep["pending"],
+        "p50_ms": conv["p50_ms"],
+        "p99_ms": conv["p99_ms"],
+        "slo": rep["slo"],
     }
 
 
@@ -180,6 +204,22 @@ def render(report: dict) -> str:
         f"  collections: "
         f"{report['collections']['lazy_materializations']} lazy "
         f"materialization(s)")
+    lag = report.get("lag") or {}
+    slo = lag.get("slo") or {}
+    if lag.get("ops_converged"):
+        lines.append(
+            f"  lag: {lag['ops_converged']} op(s) converged "
+            f"(p50 {lag['p50_ms']:g} ms, p99 {lag['p99_ms']:g} ms, "
+            f"{lag['pending']} pending), SLO {slo['target_ms']:g} ms "
+            f"-> {slo['verdict']}")
+    elif lag.get("pending"):
+        # zero converged with ops pending is a STUCK fleet, not an
+        # untraced one — the distinction an operator pages on
+        lines.append(
+            f"  lag: 0 ops converged, {lag['pending']} PENDING "
+            f"(no wave reached fleet-wide digest agreement)")
+    else:
+        lines.append("  lag: no convergence-lag records")
     return "\n".join(lines)
 
 
@@ -187,16 +227,20 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m cause_tpu.obs fleet",
         description="Render fleet health (replicas, staleness, "
-                    "divergence incidents, overflow/fallback/GC rates) "
-                    "from an obs JSONL event stream.")
-    ap.add_argument("jsonl", help="obs event file (JSON lines)")
+                    "divergence incidents, overflow/fallback/GC rates, "
+                    "convergence-lag summary) from one or more obs "
+                    "JSONL event streams (multiple streams — a multi-"
+                    "process soak's sidecars — merge by timestamp).")
+    ap.add_argument("jsonl", nargs="+",
+                    help="obs event file(s) (JSON lines)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of text")
     a = ap.parse_args(argv)
-    if not os.path.exists(a.jsonl):
-        print(f"fleet: no such file: {a.jsonl}", file=sys.stderr)
-        return 2
-    report = fleet_report(load_jsonl(a.jsonl))
+    for path in a.jsonl:
+        if not os.path.exists(path):
+            print(f"fleet: no such file: {path}", file=sys.stderr)
+            return 2
+    report = fleet_report(load_streams(a.jsonl))
     if a.json:
         print(json.dumps(report, indent=1))
     else:

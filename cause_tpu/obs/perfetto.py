@@ -31,7 +31,7 @@ import json
 from typing import Iterable, List, Optional
 
 __all__ = ["to_chrome_trace", "export_perfetto", "load_jsonl",
-           "merged_final_counters"]
+           "load_streams", "merged_final_counters"]
 
 # synthetic-tid base for the named semantic tracks: far above any real
 # OS thread id's low bits mattering for display, stable across runs so
@@ -66,6 +66,23 @@ def load_jsonl(path: str) -> List[dict]:
             if isinstance(obj, dict):
                 out.append(obj)
     return out
+
+
+def load_streams(paths: Iterable[str]) -> List[dict]:
+    """Load one or more obs JSONL streams as ONE event list — the one
+    multi-stream merge rule the ``fleet`` and ``lag`` CLIs share.
+    Multiple streams merge by record timestamp (stable sort:
+    same-timestamp records keep their per-file order), so
+    per-document "last wave" state and cumulative per-pid records
+    aggregate correctly across a multi-process soak's sidecars; a
+    single stream is returned in file order, untouched."""
+    paths = list(paths)
+    events: List[dict] = []
+    for p in paths:
+        events.extend(load_jsonl(p))
+    if len(paths) > 1:
+        events.sort(key=lambda e: e.get("ts_us") or 0)
+    return events
 
 
 def merged_final_counters(events: Iterable[dict],

@@ -73,6 +73,8 @@ SEMANTIC_EVENT_PREFIXES = (
     "collection.",
     "fleet.",
     "tree.",
+    "op.",
+    "lag.",
 )
 
 

@@ -387,14 +387,22 @@ def _assemble_level(sides_pairs, state, wcap: int) -> Dict[str, np.ndarray]:
 
 def _observe_level(uuid, level, digests, pairs, byes, delta_ops,
                    window, path, dispatches, final):
+    from ..obs import lag as _lag
     from ..obs import semantic as _sem
 
     if not _sem.enabled():
         return None
-    return _sem.observe_tree_level(
+    out = _sem.observe_tree_level(
         uuid, level, digests, [True] * len(digests), pairs=pairs,
         byes=byes, delta_ops=delta_ops, window=window, path=path,
         dispatches=dispatches, final=final)
+    # convergence-lag resolution, tree flavor: level 0 weaves every
+    # replica's stamped ops (create→woven); only the FINAL level's
+    # fleet-wide digest agreement converges them — intermediate levels
+    # converge subtrees, not the fleet
+    _lag.level_observed(uuid, agreed=bool(out and out.get("agreed")),
+                        level=level, final=final)
+    return out
 
 
 def _delta_level(pairs, state, level, uuid, byes, final):

@@ -261,6 +261,7 @@ def _observe_semantics(pairs, digests, valid, source: str):
     path. Returns the wave summary fields (``observe_wave``'s dict)
     so the cost model can join them onto its ``wave.cost`` event, or
     None when obs is off."""
+    from ..obs import lag as _lag
     from ..obs import semantic
     from ..sync import version_vector
 
@@ -277,8 +278,17 @@ def _observe_semantics(pairs, digests, valid, source: str):
                 vv[site] = h
         return vv
 
-    return semantic.observe_wave(pairs[0][0].ct.uuid, digests, valid,
-                                 vv_of=vv_of, source=source)
+    sem = semantic.observe_wave(pairs[0][0].ct.uuid, digests, valid,
+                                vv_of=vv_of, source=source)
+    # convergence-lag resolution: the wave wove every op stamped for
+    # this document (create→woven), and an agreeing wave is the
+    # fleet-converged visibility point (create→converged); a
+    # disagreeing or degenerate wave leaves the ops pending — they
+    # resolve at the first wave whose digests agree
+    _lag.wave_observed(pairs[0][0].ct.uuid,
+                       agreed=bool(sem and sem.get("agreed")),
+                       source=source)
+    return sem
 
 # Lanes sampled per tree per wave by the body spot-check below.
 # CAUSE_TPU_BODY_SAMPLE=0 disables; a value >= the tree size checks

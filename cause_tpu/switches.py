@@ -40,6 +40,7 @@ KNOWN_ENV_KNOBS = (
     "CAUSE_TPU_NATIVE_CACHE",
     "CAUSE_TPU_BODY_SAMPLE",
     "CAUSE_TPU_LEDGER",
+    "CAUSE_TPU_LAG_SLO_MS",
 )
 
 # The XLA-only streaming candidate combination ("beststream"): the

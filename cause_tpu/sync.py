@@ -39,6 +39,7 @@ from . import obs
 from .collections import shared as s
 from . import serde
 from .obs import costmodel as _cm
+from .obs import lag as _lag
 from .obs import semantic as _sem
 
 __all__ = [
@@ -137,6 +138,14 @@ def apply_delta(handle, nodes: dict, _count_as_delta: bool = True):
         # the document and drain into its NEXT wave.cost event, so
         # per-wave cost sits next to the sync layer's own accounting
         _cm.note_delta_ops(handle.ct.uuid, len(nodes))
+    if obs.enabled():
+        # convergence-lag tracer, ingest side (delta AND full-bag
+        # re-applies: either way these nodes just became visible on
+        # this replica): ops stamped at creation in-process record
+        # their apply lag against the receiving replica; foreign ops
+        # are stamped now — ingest IS their local creation time
+        _lag.ops_applied(handle.ct.uuid, nodes.keys(),
+                         replica=handle.ct.site_id)
     return merged
 
 

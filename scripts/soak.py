@@ -158,6 +158,16 @@ def wave_round(rng):
                 a = a.conj("x")
             nxt.append((a, b.extend(["y"])))
         sess.update(nxt)
+    # fleet-wide convergence closes the round: the pairs diverge from
+    # each other (each edited its own soup), so pairwise wave digests
+    # legitimately disagree across rows — the merge tree's final level
+    # is where every replica agrees on ONE digest, which is also where
+    # the convergence-lag tracer resolves this round's ops
+    root = sess.converge()
+    acc = sess.pairs[0][0]
+    for h in [x for pair in sess.pairs for x in pair][1:]:
+        acc = acc.merge(h)
+    assert (c.causal_to_edn(root) == c.causal_to_edn(acc)), "converge"
 
 
 def map_round(rng):
@@ -359,8 +369,60 @@ def _append_soak_ledger_row(args, done: int, seed: int) -> None:
               flush=True)
 
 
+def _lag_gate(args) -> int:
+    """The soak's convergence-lag regression gate (``--slo-ms``):
+    aggregate the sidecar's ``lag.window`` records, land a ``--kind
+    lag`` ledger row (best-effort, like the soak row), and return the
+    exit code — nonzero on an SLO breach, so a soak IS a lag gate.
+    Ops that never waved (list/map/gc rounds) stay pending and are
+    reported, never judged."""
+    from cause_tpu.obs import lag, ledger
+    from cause_tpu.obs.perfetto import load_jsonl
+
+    summary = lag.lag_summary(load_jsonl(args.obs_out),
+                              slo_ms_override=args.slo_ms)
+    print(lag.render(summary), flush=True)
+    try:
+        conv = summary["converged"]
+        ledger.ingest_record(
+            {
+                "platform": jax.default_backend(),
+                "metric": "soak op convergence lag p99",
+                "value": conv["p99_ms"],
+                "kernel": "soak",
+                "config": f"minutes={args.minutes:g}",
+                "smoke": False,
+            },
+            source=f"soak-lag seed0={args.seed0}",
+            kind="lag",
+            extra={"lag": {"ops_converged": summary["ops_converged"],
+                           "pending": summary["pending"],
+                           "p50_ms": conv["p50_ms"],
+                           "p99_ms": conv["p99_ms"],
+                           "slo": summary["slo"]}},
+        )
+    except Exception as e:  # noqa: BLE001 - best-effort ledger append
+        print(f"soak: lag ledger append skipped "
+              f"({type(e).__name__}: {e})", flush=True)
+    verdict = summary["slo"]["verdict"]
+    if verdict == "BREACH":
+        print(f"soak: SLO BREACH — "
+              f"{100 * summary['slo']['attainment']:.1f}% of ops "
+              f"converged within {summary['slo']['target_ms']:g} ms "
+              f"(goal {100 * lag.SLO_GOAL:.0f}%)", flush=True)
+        return 3
+    if verdict is None:
+        # a lag gate that measured nothing must fail loudly, not
+        # certify an SLO it never observed
+        print("soak: --slo-ms given but no ops converged — nothing "
+              "to gate", flush=True)
+        return 3
+    return 0
+
+
 def main():
     from cause_tpu import obs
+    from cause_tpu.obs import lag
 
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=60.0)
@@ -371,11 +433,24 @@ def main():
                          "this path instead of raw prints only; a "
                          "clean run also appends a --kind soak row to "
                          "the perf ledger")
+    ap.add_argument("--slo-ms", type=float, default=None,
+                    help="convergence-lag SLO target in ms: after a "
+                         "clean run, aggregate the sidecar's lag "
+                         "records and exit 3 if attainment misses the "
+                         "99%% goal (the soak as a lag-regression "
+                         "gate); requires --obs-out")
     args = ap.parse_args()
+    if args.slo_ms is not None and not args.obs_out:
+        ap.error("--slo-ms requires --obs-out (the gate reads the "
+                 "sidecar's lag.window records)")
     if args.obs_out:
         obs.configure(enabled=True, out=args.obs_out)
         # honest platform tags on every record (obs never asks jax)
         obs.set_platform(jax.default_backend())
+        if args.slo_ms is not None:
+            # pin the recorded SLO target so every lag.window carries
+            # the gate's own threshold, not the 100 ms default
+            lag.set_slo(args.slo_ms)
     deadline = time.monotonic() + args.minutes * 60
     seed = args.seed0
     done = 0
@@ -417,7 +492,15 @@ def main():
     obs.event("soak.done", **done_fields)
     obs.flush()
     _append_soak_ledger_row(args, done, seed)
+    rc = 0
+    if args.slo_ms is not None and obs.enabled() and args.obs_out:
+        # the lag gate (report + --kind lag row + exit code) runs
+        # only when the operator opted in with --slo-ms: a plain
+        # --obs-out soak must not dirty the committed ledger
+        rc = _lag_gate(args)
     print(f"soak finished: {done} rounds clean, no failures", flush=True)
+    if rc:
+        sys.exit(rc)
 
 
 if __name__ == "__main__":

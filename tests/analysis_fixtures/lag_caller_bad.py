@@ -1,0 +1,47 @@
+"""Known-bad OBS006 fixture: convergence-lag APIs on a traced path.
+Only the unguarded calls gate — every OBS003/OBS004/OBS005 guard
+spelling (nested if, lag.enabled, aliased import, early return,
+negated-test else) is sanctioned here too."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu.obs import lag
+from cause_tpu.obs import lag as _lag
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    lag.op_created("u", [(1, "s", 0)])                # OBS006: unguarded
+    if obs.enabled():
+        lag.op_created("u", [(1, "s", 0)])            # guarded: fine
+    if lag.enabled():
+        # the module's own guard spelling must not be flagged as an
+        # unguarded lag call itself
+        lag.wave_observed("u", agreed=True)
+    if _obs_enabled():
+        # the aliased guard + aliased module spellings are fine
+        _lag.ops_applied("u", [(1, "s", 0)], replica="r")
+    return x * 2
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    lag.wave_observed("u", agreed=False)
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful lag call), its ELSE branch is obs-on
+    # only (guarded: fine)
+    if not obs.enabled():
+        lag.level_observed("u", agreed=True, level=0, final=True)  # OBS006
+    else:
+        lag.level_observed("u", agreed=True, level=0, final=True)  # fine
+    return x

@@ -138,6 +138,22 @@ def test_costmodel_unguarded_call_on_traced_path():
     assert rules_of(res) == ["OBS005"]
 
 
+def test_lag_unguarded_call_on_traced_path():
+    """OBS006 (PR-9): the convergence-lag tracer reads monotonic
+    clocks and mutates the bounded op registry when obs is on —
+    jit-reachable code must gate it behind obs.enabled(). Exactly two
+    findings — the plain unguarded call and the body of a negated
+    test; every OBS003-OBS005 guard spelling (nested if, lag.enabled,
+    aliased module, early return, else of a negated test) is
+    sanctioned."""
+    res = run_api(os.path.join(FIX, "lag_caller_bad.py"))
+    obs6 = [f for f in res.findings if f.rule == "OBS006"]
+    assert len(obs6) == 2, [f.message for f in obs6]
+    assert "op_created" in obs6[0].message
+    assert "level_observed" in obs6[1].message
+    assert rules_of(res) == ["OBS006"]
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -251,7 +267,8 @@ def test_cli_exit_codes():
 @pytest.mark.parametrize("fixture", [
     "tid_bad.py", "jph_bad.py", os.path.join("obs", "obs_bad.py"),
     "obs_caller_bad.py", "devprof_caller_bad.py",
-    "semantic_caller_bad.py", "costmodel_caller_bad.py", "lca_bad.py",
+    "semantic_caller_bad.py", "costmodel_caller_bad.py",
+    "lag_caller_bad.py", "lca_bad.py",
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -262,7 +279,7 @@ def test_cli_list_rules():
     assert out.returncode == 0
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
-                "LCA001", "GEN001"):
+                "OBS006", "LCA001", "GEN001"):
         assert rid in out.stdout
 
 

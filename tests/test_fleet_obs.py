@@ -379,3 +379,34 @@ def test_fleet_cli_renders_real_session_stream(tmp_path):
     assert rep["staleness"] == {"0": 4}
     assert rep["divergence_incidents"] == []
     assert rep["agreed_documents"] == 1
+
+
+# ------------------------------------------------------- monitor bound
+
+
+def test_semantic_monitor_lru_bound():
+    """PR-5 bounded the divergence-monitor state at 4096 documents for
+    uuid-churn soaks (600k rounds mint a uuid per round) but never
+    pinned the eviction path: filling past the bound must evict the
+    least-recently-waved documents, keep the registry at the cap, and
+    LRU-refresh documents that wave again."""
+    obs.configure(enabled=True)
+    cap = semantic._MON_MAX
+    assert cap == 4096
+    for i in range(cap + 200):
+        semantic.observe_wave(f"doc{i}", [1, 1], [True, True])
+    assert len(semantic._MON) == cap
+    # the oldest 200 evicted, the newest retained
+    assert ("doc0", "wave") not in semantic._MON
+    assert ("doc199", "wave") not in semantic._MON
+    assert ("doc200", "wave") in semantic._MON
+    assert (f"doc{cap + 199}", "wave") in semantic._MON
+    # re-waving an old survivor refreshes it (state intact), so new
+    # arrivals evict the now-oldest documents instead of it
+    semantic.observe_wave("doc200", [1, 1], [True, True])
+    assert semantic._MON[("doc200", "wave")]["wave"] == 2
+    for i in range(100):
+        semantic.observe_wave(f"fresh{i}", [1], [True])
+    assert ("doc200", "wave") in semantic._MON
+    assert ("doc300", "wave") not in semantic._MON
+    assert len(semantic._MON) == cap
