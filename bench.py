@@ -181,6 +181,21 @@ class _Overflow(RuntimeError):
     pass
 
 
+def _require_obs(mode: str) -> None:
+    """The obs-required guard shared by every bench mode whose metric
+    or evidence is computed FROM the obs event stream (BENCH_LAG's lag
+    summary, BENCH_DIV_SWEEP's per-path gap verdicts, BENCH_TREE's
+    per-level decomposition): without CAUSE_TPU_OBS=1 the mode would
+    pay the full marshal + measured work and land an obs-less artifact
+    nobody can analyze — fail loudly up front instead."""
+    if not obs.enabled():
+        raise SystemExit(
+            f"bench: {mode} requires CAUSE_TPU_OBS=1 (its evidence — "
+            f"wave.cost/wave.digest/lag records — is computed from "
+            f"the obs event stream; set CAUSE_TPU_OBS_OUT=<path> to "
+            f"keep the sidecar)")
+
+
 def _sweep_levels() -> list:
     """Parse BENCH_DIV_SWEEP ("10,50,500,5000": per-pair total
     divergence ops per level). Empty when the sweep mode is off."""
@@ -253,6 +268,26 @@ def _lag_bench(real_platform: str, tag: str, smoke: bool, rounds: int,
     from cause_tpu.obs import lag as lag_mod
     from cause_tpu.parallel.session import FleetSession
 
+    def _mode_row(kind, metric, value, config, extra):
+        """One best-effort session-kernel ledger row (the lag and
+        live rows share everything but their payload)."""
+        try:
+            from cause_tpu.obs import ledger
+
+            ledger.ingest_record(
+                {"platform": tag or real_platform,
+                 "metric": metric,
+                 "value": value,
+                 "kernel": "session",
+                 "config": config,
+                 "schema_version": BENCH_SCHEMA_VERSION},
+                source=f"bench-{kind}@{time.strftime('%Y-%m-%d')}",
+                kind=kind,
+                extra=extra)
+        except Exception as e:  # noqa: BLE001 - best-effort rows
+            print(f"bench: {kind} ledger append failed ({e})",
+                  file=sys.stderr)
+
     rows = []
     for n, handles in marshals:
         bail()
@@ -285,6 +320,19 @@ def _lag_bench(real_platform: str, tag: str, smoke: bool, rounds: int,
         lag_mod.set_slo(slo_ms)
         fleet_epoch = lag_mod.current_epoch()
 
+        # BENCH_LIVE=1: attach the PR-10 live monitor to this
+        # process's own sink for the measured block — the in-process
+        # subscriber feed, default alert rules, one live.snapshot per
+        # wave round — and time every poll, so the committed evidence
+        # carries the monitor's overhead as a fraction of the wave
+        # wall time it observed (<2% is the acceptance bar)
+        live_att = None
+        monitor_s = 0.0
+        if _flag("BENCH_LIVE"):
+            from cause_tpu.obs import live as live_mod
+
+            live_att = live_mod.attach(source=f"bench-lag-n{n}")
+
         # measured block: steady-state wave rounds ONLY — the signal
         # an admission controller batches against. A closing tree
         # converge() was tried and rejected: its per-level programs
@@ -294,11 +342,17 @@ def _lag_bench(real_platform: str, tag: str, smoke: bool, rounds: int,
         # convergence lag. The tree resolution path is evidenced by
         # tests/test_lag.py, the soak's wave_round converge, and the
         # CI smokes instead.
+        t_meas0 = time.perf_counter()
         for r in range(rounds):
             bail()
             sess.update([(a.conj(f"r{r}"), b.conj(f"q{r}"))
                          for a, b in sess.pairs])
             sess.wave()
+            if live_att is not None:
+                t_mon = time.perf_counter()
+                live_att.poll(emit_snapshot=True)
+                monitor_s += time.perf_counter() - t_mon
+        measured_s = time.perf_counter() - t_meas0
         summary = lag_mod.lag_summary(obs.events(), epoch=fleet_epoch)
         conv = summary["converged"]
         slo = summary["slo"]
@@ -313,28 +367,44 @@ def _lag_bench(real_platform: str, tag: str, smoke: bool, rounds: int,
             "attainment": slo["attainment"],
             "verdict": slo["verdict"],
         }
+        live_row = None
+        if live_att is not None:
+            snap = live_att.poll(emit_snapshot=True)
+            wave_s = max(1e-9, measured_s - monitor_s)
+            live_row = {
+                "replicas": n, "rounds": rounds,
+                "snapshots": live_att.monitor.snapshots_emitted,
+                "snapshot_cadence": "per wave round",
+                "alerts": len(live_att.monitor.alerts),
+                "alert_rules": list(
+                    r_.spec for r_ in live_att.monitor.rules),
+                "queue_dropped": live_att.dropped,
+                "records_folded": snap["records"],
+                "monitor_ms": round(monitor_s * 1000.0, 3),
+                "wave_wall_ms": round(wave_s * 1000.0, 3),
+                "overhead_pct": round(100.0 * monitor_s / wave_s, 3),
+            }
+            row["live"] = live_row
+            live_att.close()
         rows.append(row)
         print(f"bench: lag n={n}: {summary['ops_converged']} ops over "
               f"{rounds} round(s), p50 {conv['p50_ms']} ms / p99 "
               f"{conv['p99_ms']} ms, SLO {slo['target_ms']:g} ms -> "
               f"{slo['verdict']}", file=sys.stderr)
-        try:
-            from cause_tpu.obs import ledger
-
-            ledger.ingest_record(
-                {"platform": tag or real_platform,
-                 "metric": f"op convergence lag p99, {n} replicas x "
-                           f"{doc + 1}-node CausalLists",
-                 "value": conv["p99_ms"],
-                 "kernel": "session",
-                 "config": f"n{n}-lag",
-                 "schema_version": BENCH_SCHEMA_VERSION},
-                source=f"bench-lag@{time.strftime('%Y-%m-%d')}",
-                kind="lag",
-                extra={"lag": row})
-        except Exception as e:  # noqa: BLE001 - best-effort rows
-            print(f"bench: lag ledger append failed ({e})",
+        if live_row is not None:
+            print(f"bench: live n={n}: {live_row['snapshots']} "
+                  f"snapshot(s), {live_row['alerts']} alert(s), "
+                  f"monitor {live_row['monitor_ms']:g} ms = "
+                  f"{live_row['overhead_pct']:g}% of wave wall",
                   file=sys.stderr)
+            _mode_row("live",
+                      f"live monitor overhead, {n} replicas x "
+                      f"{doc + 1}-node CausalLists",
+                      None, f"n{n}-live", {"live": live_row})
+        _mode_row("lag",
+                  f"op convergence lag p99, {n} replicas x "
+                  f"{doc + 1}-node CausalLists",
+                  conv["p99_ms"], f"n{n}-lag", {"lag": row})
     obs.flush()
     return {
         "metric": f"per-op convergence lag over FleetSession rounds, "
@@ -786,6 +856,7 @@ def measure(platform: str) -> dict:
     smoke = _flag("BENCH_SMOKE")
     sweep = _sweep_levels()
     if sweep:
+        _require_obs("BENCH_DIV_SWEEP")
         # divergence sweep mode: per-level marshals replace the single
         # headline marshal. ALL levels marshal here, before the
         # backend claim (window economy — tens of seconds of host
@@ -815,6 +886,7 @@ def measure(platform: str) -> dict:
                                  doc=sw_doc, cap=sw_cap)
     tree_ns = _tree_sizes()
     if tree_ns:
+        _require_obs("BENCH_TREE")
         # merge-tree mode: REAL replica handles (the fold baseline
         # needs them), marshalled jax-free BEFORE the backend claim —
         # tree_fleet_handles builds the base weave with the pure host
@@ -835,14 +907,7 @@ def measure(platform: str) -> dict:
                            div=t_div)
     lag_ns = _lag_sizes()
     if lag_ns:
-        if not obs.enabled():
-            # the lag metric is entirely obs-derived: without obs the
-            # mode would pay the full marshal + measured rounds and
-            # land a null-value row — fail loudly like a malformed
-            # BENCH_LAG instead
-            raise SystemExit("bench: BENCH_LAG requires CAUSE_TPU_OBS=1 "
-                             "(the lag metric is computed from the obs "
-                             "event stream)")
+        _require_obs("BENCH_LAG")
         # convergence-lag mode: REAL replica handles paired into a
         # FleetSession, marshalled jax-free BEFORE the backend claim
         # (same window-economy rule as the tree mode)

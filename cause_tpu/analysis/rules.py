@@ -750,6 +750,45 @@ def check_obs006(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                     "hoist it off the traced path)")
 
 
+# distinctive bare names only: generic verbs (attach/feed/poll/
+# snapshot) are matched through their ``live`` module qualifier
+# instead, or they would flag every unrelated object with a feed()
+_LIVE_APIS = frozenset(
+    {"LiveMonitor", "LiveFold", "LiveAttachment", "emit_snapshot",
+     "default_rules", "parse_rule"}
+)
+
+
+@rule("OBS007",
+      "live-telemetry API reached from jit-reachable code without an "
+      "obs.enabled() guard (the live layer folds records, takes "
+      "monitor locks and evaluates alert rules the moment obs is on)")
+def check_obs007(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module):
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]):
+                # live.enabled()-style guards ARE the sanctioned guard
+                continue
+            is_live = (
+                parts[-1] in _LIVE_APIS
+                or any(p in ("live", "_live") for p in parts[:-1])
+            )
+            if is_live and not guarded:
+                yield _finding(
+                    "OBS007", module, call,
+                    f"live.{parts[-1]}() on a jit-reachable path "
+                    "without an obs.enabled() guard — unlike the "
+                    "no-op span/counter factories, the live monitor "
+                    "drains subscriber queues, folds records and "
+                    "evaluates alert rules when obs is on; gate the "
+                    "call (or hoist it off the traced path)")
+
+
 # ----------------------------------------------------------------- LCA
 
 @rule("LCA001",

@@ -20,10 +20,13 @@ from .core import (
     reset,
     set_platform,
     span,
+    subscribe,
+    unsubscribe,
 )
 from .perfetto import export_perfetto, load_jsonl, to_chrome_trace
 from . import costmodel
 from . import lag
+from . import live
 from . import semantic
 
 __all__ = [
@@ -39,10 +42,13 @@ __all__ = [
     "flush",
     "gauge",
     "lag",
+    "live",
     "load_jsonl",
     "reset",
     "semantic",
     "set_platform",
     "span",
+    "subscribe",
     "to_chrome_trace",
+    "unsubscribe",
 ]

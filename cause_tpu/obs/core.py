@@ -67,6 +67,8 @@ __all__ = [
     "export_jsonl",
     "set_platform",
     "reset",
+    "subscribe",
+    "unsubscribe",
 ]
 
 _TRUTHY = ("1", "true", "yes")
@@ -109,6 +111,44 @@ class _NullInstrument:
 _NULL_SPAN = _NullSpan()
 _NULL_INSTRUMENT = _NullInstrument()
 
+# default bound of one in-process subscriber queue (obs.live's
+# monitor drains on its own cadence; a stalled reader must cost the
+# writer one deque append and nothing else)
+_SUBSCRIBER_QUEUE = 8192
+
+
+class Subscription:
+    """One bounded in-process subscriber on the sink: every record the
+    substrate emits is appended to this queue the moment it lands in
+    the ring (newest win when the reader falls behind — ``dropped``
+    counts the loss honestly). Readers drain with :meth:`drain` on
+    their own cadence; the writer never blocks and never runs reader
+    code (no callback re-entrancy under the state lock). Created via
+    :func:`subscribe`, torn down via :func:`unsubscribe`."""
+
+    __slots__ = ("queue", "dropped", "lock", "closed")
+
+    def __init__(self, maxlen: int):
+        self.queue = deque(maxlen=max(1, int(maxlen)))
+        self.dropped = 0
+        self.lock = threading.Lock()
+        self.closed = False
+
+    def push(self, obj: dict) -> None:
+        with self.lock:
+            if self.closed:
+                return
+            if len(self.queue) == self.queue.maxlen:
+                self.dropped += 1
+            self.queue.append(obj)
+
+    def drain(self) -> list:
+        """All queued records, oldest first (and empties the queue)."""
+        with self.lock:
+            out = list(self.queue)
+            self.queue.clear()
+        return out
+
 
 class _State:
     """One process-wide obs state (enabled flag, registry, ring,
@@ -116,7 +156,7 @@ class _State:
 
     __slots__ = (
         "enabled", "out", "ring", "counters", "gauges", "lock",
-        "tls", "fd", "platform", "ids", "atexit_armed",
+        "tls", "fd", "platform", "ids", "atexit_armed", "subscribers",
     )
 
     def __init__(self, enabled_: bool, out: str, ring_size: int):
@@ -131,6 +171,10 @@ class _State:
         self.platform = os.environ.get("JAX_PLATFORMS", "").split(",")[0]
         self.ids = itertools.count(1)
         self.atexit_armed = False
+        # in-process live subscribers (obs.live monitors); empty
+        # tuple in the common case so record() pays one attribute
+        # read, nothing else
+        self.subscribers: tuple = ()
 
     # ---------------------------------------------------------- sink
     def write_line(self, obj: dict) -> None:
@@ -157,6 +201,8 @@ class _State:
     def record(self, obj: dict) -> None:
         self.ring.append(obj)
         self.write_line(obj)
+        for sub in self.subscribers:
+            sub.push(obj)
 
 
 _STATE: Optional[_State] = None
@@ -210,11 +256,20 @@ def configure(enabled: Optional[bool] = None,
     with _STATE_LOCK:
         cur = _STATE
         if reset or cur is None:
-            if cur is not None and cur.fd is not None:
-                try:
-                    os.close(cur.fd)
-                except OSError:
-                    pass
+            if cur is not None:
+                if cur.fd is not None:
+                    try:
+                        os.close(cur.fd)
+                    except OSError:
+                        pass
+                # a reset drops ALL obs state, subscribers included —
+                # mark them closed so a live attachment polling a
+                # dead queue can SEE it died (sub.closed) instead of
+                # silently draining nothing forever
+                with cur.lock:
+                    for s in cur.subscribers:
+                        s.closed = True
+                    cur.subscribers = ()
             _STATE = None
         if reset and enabled is None and out is None \
                 and ring_size is None:
@@ -252,6 +307,38 @@ def set_platform(platform: str) -> None:
     jax, so it cannot ask)."""
     st = _resolve_state()
     st.platform = str(platform)
+
+
+def subscribe(maxlen: int = _SUBSCRIBER_QUEUE) -> Optional[Subscription]:
+    """Attach a bounded in-process subscriber to the sink: every
+    subsequently recorded event/span/gauge/counter snapshot is queued
+    for the subscriber to :meth:`Subscription.drain` on its own
+    cadence (the ``obs.live`` in-process feed). Returns None when obs
+    is disabled — the obs-off contract is zero state, so a disabled
+    process keeps no subscriber registry at all. An ``obs.reset()`` /
+    ``configure(reset=True)`` detaches every subscriber and marks it
+    ``closed`` — the holder must re-subscribe against the new state
+    (``live.LiveAttachment.closed`` surfaces this)."""
+    st = _resolve_state()
+    if not st.enabled:
+        return None
+    sub = Subscription(maxlen)
+    with st.lock:
+        st.subscribers = st.subscribers + (sub,)
+    return sub
+
+
+def unsubscribe(sub: Optional[Subscription]) -> None:
+    """Detach a subscriber (idempotent; None is a no-op so callers can
+    pass the obs-off :func:`subscribe` result straight back)."""
+    if sub is None:
+        return
+    sub.closed = True
+    st = _STATE
+    if st is None:
+        return
+    with st.lock:
+        st.subscribers = tuple(s for s in st.subscribers if s is not sub)
 
 
 def _switches_snapshot() -> Dict[str, str]:

@@ -44,7 +44,8 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from . import core
 
@@ -61,6 +62,7 @@ __all__ = [
     "wave_begin",
     "wave_abandon",
     "wave_cost",
+    "CostReducer",
     "costmodel_digest",
     "cost_vs_divergence",
     "tree_decomposition",
@@ -348,6 +350,13 @@ def cost_vs_divergence(waves: Sequence[dict]) -> dict:
         y = f.get("wall_ms")
         if x is not None and isinstance(y, (int, float)):
             pts.append((float(x), float(y)))
+    return _fit_points(pts)
+
+
+def _fit_points(pts: Sequence[Tuple[float, float]]) -> dict:
+    """The fit proper, over already-extracted (divergence, wall_ms)
+    points — shared by the batch pass and :class:`CostReducer` so the
+    live fold's curve is bit-equal to the batch one."""
     out: dict = {"points": len(pts)}
     if len(pts) < 2:
         out["verdict"] = "insufficient-data"
@@ -386,26 +395,110 @@ def cost_vs_divergence(waves: Sequence[dict]) -> dict:
     return out
 
 
+class CostReducer:
+    """Incremental form of :func:`costmodel_digest`: feed obs records
+    one at a time (a live tail, a subscriber queue), read the
+    cost-model aggregate at any point. Totals accumulate in stream
+    order — integer sums are exact and the wall-ms float sum runs in
+    the identical order as the batch pass, so :meth:`digest` is
+    bit-equal to ``costmodel_digest(events)`` on the same stream.
+
+    Regression points are kept per path too (``"delta"`` / ``"full"``
+    / the emitting source), on bounded oldest-dropped deques
+    (``points_max``) — a live monitor over an unbounded stream must
+    not grow without bound, and the fold must stay O(1) per record;
+    truncation is reported honestly, pooled via ``points_dropped``
+    and per path in the ``curves_by_path`` fits."""
+
+    __slots__ = ("waves", "dispatches", "delta_ops", "full_bag",
+                 "wall_ms", "lanes_max", "_pts", "_pts_by_path",
+                 "_dropped_by_path", "points_max", "points_dropped")
+
+    def __init__(self, points_max: int = 65536):
+        self.waves = 0
+        self.dispatches = 0
+        self.delta_ops = 0
+        self.full_bag = 0
+        self.wall_ms = 0.0
+        self.lanes_max = 0
+        self.points_max = int(points_max)
+        self._pts = deque(maxlen=self.points_max)
+        self._pts_by_path: Dict[str, deque] = {}
+        self._dropped_by_path: Dict[str, int] = {}
+        self.points_dropped = 0
+
+    def feed(self, e: dict) -> None:
+        if e.get("ev") != "event" or e.get("name") != "wave.cost":
+            return
+        f = e.get("fields") or {}
+        self.waves += 1
+        self.dispatches += int(f.get("dispatches") or 0)
+        self.delta_ops += int(f.get("delta_ops") or 0)
+        self.full_bag += int(f.get("full_bag") or 0)
+        self.wall_ms += float(f.get("wall_ms") or 0.0)
+        self.lanes_max = max(self.lanes_max, int(f.get("lanes") or 0))
+        x = _divergence_of(f)
+        y = f.get("wall_ms")
+        if x is not None and isinstance(y, (int, float)):
+            pt = (float(x), float(y))
+            if len(self._pts) == self.points_max:
+                self.points_dropped += 1
+            self._pts.append(pt)
+            path = str(f.get("path") or f.get("source") or "?")
+            by = self._pts_by_path.get(path)
+            if by is None:
+                by = self._pts_by_path[path] = deque(
+                    maxlen=self.points_max)
+            if len(by) == by.maxlen:
+                self._dropped_by_path[path] = \
+                    self._dropped_by_path.get(path, 0) + 1
+            by.append(pt)
+
+    def curve(self) -> dict:
+        """The pooled cost-vs-divergence fit (``_fit_points``)."""
+        return _fit_points(self._pts)
+
+    def curves_by_path(self) -> Dict[str, dict]:
+        """Per-path fits, only meaningful with >1 path (the delta
+        -vs-full A/B shape ``gap_report`` renders). A path whose
+        deque truncated carries its own ``points_dropped`` — the
+        verdict was fitted over a window, and the reader must know."""
+        out = {}
+        for k, v in sorted(self._pts_by_path.items()):
+            fit = _fit_points(v)
+            if self._dropped_by_path.get(k):
+                fit["points_dropped"] = self._dropped_by_path[k]
+            out[k] = fit
+        return out
+
+    def digest(self) -> dict:
+        """``costmodel_digest``'s dict (empty when no waves fed)."""
+        if not self.waves:
+            return {}
+        out = {
+            "waves": self.waves,
+            "dispatches": self.dispatches,
+            "delta_ops": self.delta_ops,
+            "full_bag": self.full_bag,
+            "wall_ms": round(self.wall_ms, 3),
+            "lanes_max": self.lanes_max,
+        }
+        out["slope"] = self.curve()
+        if self.points_dropped:
+            out["points_dropped"] = self.points_dropped
+        return out
+
+
 def costmodel_digest(events: Sequence[dict]) -> dict:
     """The cost-model aggregate of one obs stream — the ledger row
     extension (``row["cost"]``): wave/dispatch totals, divergence
     totals, and the slope verdict. Empty dict when the stream carries
-    no ``wave.cost`` events."""
-    waves = _wave_cost_events(events)
-    if not waves:
-        return {}
-    out = {
-        "waves": len(waves),
-        "dispatches": sum(int(f.get("dispatches") or 0) for f in waves),
-        "delta_ops": sum(int(f.get("delta_ops") or 0) for f in waves),
-        "full_bag": sum(int(f.get("full_bag") or 0) for f in waves),
-        "wall_ms": round(sum(float(f.get("wall_ms") or 0.0)
-                             for f in waves), 3),
-        "lanes_max": max(int(f.get("lanes") or 0) for f in waves),
-    }
-    curve = cost_vs_divergence(waves)
-    out["slope"] = curve
-    return out
+    no ``wave.cost`` events. The batch form of :class:`CostReducer` —
+    one shared body, so live folds match ledger digests bit-for-bit."""
+    r = CostReducer()
+    for e in events:
+        r.feed(e)
+    return r.digest()
 
 
 # --------------------------------------------------------- gap report

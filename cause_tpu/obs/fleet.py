@@ -39,21 +39,164 @@ import argparse
 import json
 import os
 import sys
-from typing import Dict, Iterable, List
+from typing import Dict, List
 
-from .lag import lag_summary
-from .perfetto import load_streams, merged_final_counters
+from .lag import LagReducer
+from .perfetto import CountersReducer, load_streams
 
-__all__ = ["fleet_report", "render", "load_streams", "main"]
-
-
-def _events_named(events: Iterable[dict], name: str) -> List[dict]:
-    return [e for e in events
-            if e.get("ev") == "event" and e.get("name") == name]
+__all__ = ["FleetReducer", "fleet_report", "render", "load_streams",
+           "main"]
 
 
 def _rate(part: float, whole: float) -> float:
     return round(part / whole, 4) if whole else 0.0
+
+
+class FleetReducer:
+    """Incremental form of :func:`fleet_report`: feed obs records one
+    at a time (a live tail, an in-process subscriber queue) and render
+    the fleet-health report at any point. ``fleet_report`` is this
+    reducer fed with the whole stream — one shared body, bit-equal by
+    construction (the ``obs.live`` acceptance property).
+
+    State is bounded by fleet shape, not stream length: one last-wave
+    record per document (the semantic monitor's own LRU rule bounds
+    distinct documents at the write side), one counter snapshot per
+    pid, one lag record per (pid, epoch) — plus the divergence
+    incident list, bounded at ``incidents_max`` (drops are counted,
+    never silent; a healthy fleet mints zero incidents)."""
+
+    __slots__ = ("records", "_last_wave", "_waves", "_incidents",
+                 "incidents_dropped", "incidents_max", "_counters",
+                 "lag")
+
+    def __init__(self, incidents_max: int = 10000):
+        self.records = 0
+        self._last_wave: Dict[str, dict] = {}
+        self._waves = 0
+        self._incidents: List[dict] = []
+        self.incidents_dropped = 0
+        self.incidents_max = int(incidents_max)
+        self._counters = CountersReducer()
+        self.lag = LagReducer()
+
+    def feed_counters(self, e: dict) -> None:
+        """Overlay a counters snapshot WITHOUT counting it as a
+        stream record — the in-process live attachment samples the
+        counter registry directly (counters only reach the stream at
+        flush), and that overlay must not make the fold's record
+        count disagree with the sidecar it mirrors."""
+        self._counters.feed(e)
+
+    def feed(self, e: dict) -> None:
+        """Consume one obs record (spans and foreign events are
+        counted but otherwise free)."""
+        self.records += 1
+        self._counters.feed(e)
+        self.lag.feed(e)
+        if e.get("ev") != "event":
+            return
+        name = e.get("name")
+        if name == "wave.digest":
+            f = e.get("fields") or {}
+            # the LAST wave per DOCUMENT (stream order, regardless of
+            # wave/session source) is its current state — a doc
+            # observed by both merge_wave and a FleetSession is still
+            # ONE doc, and summing per-source histograms would double
+            # -count its pairs and report agreed_documents > documents
+            self._last_wave[str(f.get("uuid"))] = f
+            self._waves += 1
+        elif name == "divergence":
+            f = e.get("fields") or {}
+            if len(self._incidents) >= self.incidents_max:
+                self.incidents_dropped += 1
+                return
+            self._incidents.append({
+                "uuid": f.get("uuid"), "source": f.get("source"),
+                "wave": f.get("wave"), "pair": f.get("pair"),
+                "site": f.get("site"),
+                "site_expected": f.get("site_expected"),
+                "site_got": f.get("site_got"),
+                "disagreeing": f.get("disagreeing"),
+            })
+
+    def report(self) -> dict:
+        """The fleet-health dict (see :func:`fleet_report`)."""
+        counters = self._counters.totals()
+        staleness: Dict[str, int] = {}
+        pairs = 0
+        agreed_now = 0
+        for f in self._last_wave.values():
+            pairs = max(pairs, int(f.get("pairs") or 0))
+            if f.get("agreed"):
+                agreed_now += 1
+            for bucket, n in (f.get("staleness") or {}).items():
+                staleness[str(bucket)] = staleness.get(str(bucket), 0) + n
+
+        delta_rounds = counters.get("sync.delta_rounds", 0)
+        full_bag = counters.get("sync.full_bag", 0)
+        wave_pairs = counters.get("wave.pairs", 0)
+        fallback = counters.get("wave.fallback", 0)
+        poisoned = counters.get("wave.poisoned", 0)
+        overflow = counters.get("wave.overflow_retry", 0)
+        examined = counters.get("gc.nodes_examined", 0)
+        reclaimed = counters.get("gc.nodes_reclaimed", 0)
+
+        out = {
+            "events": self.records,
+            "documents": len(self._last_wave),
+            "waves": self._waves,
+            "pairs": pairs,
+            "replicas": 2 * pairs,
+            "agreed_documents": agreed_now,
+            "staleness": dict(sorted(staleness.items(),
+                                     key=lambda kv: int(kv[0]))),
+            "divergence_incidents": list(self._incidents),
+            "sync": {
+                "delta_rounds": delta_rounds,
+                "delta_nodes": counters.get("sync.delta_nodes", 0),
+                "full_bag": full_bag,
+                "full_bag_rate": _rate(full_bag,
+                                       delta_rounds + full_bag),
+            },
+            "wave": {
+                "pairs": wave_pairs,
+                "fallback": fallback,
+                "fallback_rate": _rate(fallback, wave_pairs),
+                "poisoned": poisoned,
+                "overflow_retries": overflow,
+                "session_overflow":
+                    counters.get("fleet.session_overflow", 0),
+            },
+            "gc": {
+                "runs": counters.get("gc.runs", 0),
+                "nodes_examined": examined,
+                "nodes_reclaimed": reclaimed,
+                "reclaim_rate": _rate(reclaimed, examined),
+                "safety_valve": counters.get("gc.safety_valve", 0),
+            },
+            "collections": {
+                "lazy_materializations":
+                    counters.get("collection.lazy_materialize", 0),
+            },
+            "lag": self._lag_section(),
+        }
+        if self.incidents_dropped:
+            out["divergence_incidents_dropped"] = self.incidents_dropped
+        return out
+
+    def _lag_section(self) -> dict:
+        """The compact convergence-lag block of the fleet report (the
+        full distribution lives in ``python -m cause_tpu.obs lag``)."""
+        rep = self.lag.report()
+        conv = rep["converged"]
+        return {
+            "ops_converged": rep["ops_converged"],
+            "pending": rep["pending"],
+            "p50_ms": conv["p50_ms"],
+            "p99_ms": conv["p99_ms"],
+            "slo": rep["slo"],
+        }
 
 
 def fleet_report(events: List[dict]) -> dict:
@@ -61,102 +204,13 @@ def fleet_report(events: List[dict]) -> dict:
     CLI renders (see module docstring for the sections). Total: the
     report is well-defined on an EMPTY stream — every section zeroes
     out — because an operator's first question to a broken run is
-    "did anything record at all?"."""
-    waves = _events_named(events, "wave.digest")
-    divergences = _events_named(events, "divergence")
-    counters = merged_final_counters(events)
-
-    # fleet shape + convergence: the LAST wave per DOCUMENT (stream
-    # order, regardless of wave/session source) is its current state —
-    # a doc observed by both merge_wave and a FleetSession is still
-    # ONE doc, and summing per-source histograms would double-count
-    # its pairs and report agreed_documents > documents
-    last_wave: Dict[str, dict] = {}
-    for e in waves:
-        f = e.get("fields") or {}
-        last_wave[str(f.get("uuid"))] = f
-    staleness: Dict[str, int] = {}
-    pairs = 0
-    agreed_now = 0
-    for f in last_wave.values():
-        pairs = max(pairs, int(f.get("pairs") or 0))
-        if f.get("agreed"):
-            agreed_now += 1
-        for bucket, n in (f.get("staleness") or {}).items():
-            staleness[str(bucket)] = staleness.get(str(bucket), 0) + n
-
-    incidents = []
-    for e in divergences:
-        f = e.get("fields") or {}
-        incidents.append({
-            "uuid": f.get("uuid"), "source": f.get("source"),
-            "wave": f.get("wave"), "pair": f.get("pair"),
-            "site": f.get("site"),
-            "site_expected": f.get("site_expected"),
-            "site_got": f.get("site_got"),
-            "disagreeing": f.get("disagreeing"),
-        })
-
-    delta_rounds = counters.get("sync.delta_rounds", 0)
-    full_bag = counters.get("sync.full_bag", 0)
-    wave_pairs = counters.get("wave.pairs", 0)
-    fallback = counters.get("wave.fallback", 0)
-    poisoned = counters.get("wave.poisoned", 0)
-    overflow = counters.get("wave.overflow_retry", 0)
-    examined = counters.get("gc.nodes_examined", 0)
-    reclaimed = counters.get("gc.nodes_reclaimed", 0)
-
-    return {
-        "events": len(events),
-        "documents": len(last_wave),
-        "waves": len(waves),
-        "pairs": pairs,
-        "replicas": 2 * pairs,
-        "agreed_documents": agreed_now,
-        "staleness": dict(sorted(staleness.items(),
-                                 key=lambda kv: int(kv[0]))),
-        "divergence_incidents": incidents,
-        "sync": {
-            "delta_rounds": delta_rounds,
-            "delta_nodes": counters.get("sync.delta_nodes", 0),
-            "full_bag": full_bag,
-            "full_bag_rate": _rate(full_bag, delta_rounds + full_bag),
-        },
-        "wave": {
-            "pairs": wave_pairs,
-            "fallback": fallback,
-            "fallback_rate": _rate(fallback, wave_pairs),
-            "poisoned": poisoned,
-            "overflow_retries": overflow,
-            "session_overflow": counters.get("fleet.session_overflow", 0),
-        },
-        "gc": {
-            "runs": counters.get("gc.runs", 0),
-            "nodes_examined": examined,
-            "nodes_reclaimed": reclaimed,
-            "reclaim_rate": _rate(reclaimed, examined),
-            "safety_valve": counters.get("gc.safety_valve", 0),
-        },
-        "collections": {
-            "lazy_materializations":
-                counters.get("collection.lazy_materialize", 0),
-        },
-        "lag": _lag_section(events),
-    }
-
-
-def _lag_section(events: List[dict]) -> dict:
-    """The compact convergence-lag block of the fleet report (the full
-    distribution lives in ``python -m cause_tpu.obs lag``)."""
-    rep = lag_summary(events)
-    conv = rep["converged"]
-    return {
-        "ops_converged": rep["ops_converged"],
-        "pending": rep["pending"],
-        "p50_ms": conv["p50_ms"],
-        "p99_ms": conv["p99_ms"],
-        "slo": rep["slo"],
-    }
+    "did anything record at all?". The batch form of
+    :class:`FleetReducer` — one shared body, so the live fold cannot
+    drift from this report."""
+    r = FleetReducer()
+    for e in events:
+        r.feed(e)
+    return r.report()
 
 
 def render(report: dict) -> str:

@@ -89,6 +89,7 @@ __all__ = [
     "level_observed",
     "pending_ops",
     "current_epoch",
+    "LagReducer",
     "lag_summary",
     "render",
     "main",
@@ -582,24 +583,138 @@ def pending_ops(uuid: Optional[str] = None) -> int:
 # -------------------------------------------------------- read side
 
 
-def _last_per_pid(events: Sequence[dict], name: str,
-                  extra_keys: Tuple[str, ...] = ()) -> List[dict]:
-    """The LAST ``name`` event's fields per (pid, *extra field keys*)
-    — the cumulative-record merge rule the counter snapshots use,
-    extended to keyed cumulative records. ``epoch`` is always part of
-    the key: cumulative histograms restart at every in-process
-    ``reset()`` (a multi-fleet bench), and collapsing across epochs
-    would drop every generation but the last."""
-    latest: Dict[Tuple, dict] = {}
-    for e in events:
-        if e.get("ev") != "event" or e.get("name") != name:
-            continue
-        f = e.get("fields") or {}
-        key = (e.get("pid", 0), f.get("epoch"))
-        for k in extra_keys:
-            key += (f.get(k),)
-        latest[key] = f
-    return list(latest.values())
+class LagReducer:
+    """The incremental twin of :func:`lag_summary`: feed obs records
+    ONE AT A TIME (a live tail, an in-process subscriber queue) and
+    ask for the report at any point. ``lag_summary`` itself is this
+    reducer fed with the whole stream, so the two are bit-equal by
+    construction — the acceptance property ``obs.live`` pins.
+
+    The merge rule is unchanged: cumulative ``lag.window`` records
+    collapse to the LAST per (pid, reset-epoch) and then SUM;
+    ``lag.replica`` records collapse per (pid, epoch, replica,
+    generation). Memory is bounded by the number of distinct
+    (pid, epoch[, replica, gen]) keys in the stream — process count ×
+    reset count, not op count."""
+
+    __slots__ = ("_windows", "_replicas")
+
+    def __init__(self):
+        # key -> fields; dict preserves FIRST-insertion order under
+        # reassignment, exactly like the batch pass's last-per-key
+        # fold, so merge order (and float summation order) is
+        # identical to the whole-stream pass
+        self._windows: Dict[Tuple, dict] = {}
+        self._replicas: Dict[Tuple, dict] = {}
+
+    def feed(self, e: dict) -> None:
+        """Consume one obs record (non-lag records are free)."""
+        if e.get("ev") != "event":
+            return
+        name = e.get("name")
+        if name == "lag.window":
+            f = e.get("fields") or {}
+            self._windows[(e.get("pid", 0), f.get("epoch"))] = f
+        elif name == "lag.replica":
+            f = e.get("fields") or {}
+            self._replicas[(e.get("pid", 0), f.get("epoch"),
+                            f.get("replica"), f.get("gen"))] = f
+
+    def report(self, slo_ms_override: Optional[float] = None,
+               epoch: Optional[int] = None) -> dict:
+        """The lag report (see :func:`lag_summary` for the fields).
+        Cheap relative to the stream: cost is proportional to the
+        number of distinct cumulative-record keys, so a live monitor
+        can call it on every snapshot tick."""
+        windows = [f for f in self._windows.values()
+                   if epoch is None or f.get("epoch") == epoch]
+        h_woven = LagHistogram()
+        h_conv = LagHistogram()
+        converged_total = 0
+        breach_total = 0
+        pending = 0
+        recorded_slo = None
+        last_win = {}
+        for f in windows:
+            h_woven.merge(LagHistogram.from_fields(f.get("hist_woven")))
+            h_conv.merge(LagHistogram.from_fields(f.get("hist_converged")))
+            converged_total += int(f.get("converged_total") or 0)
+            breach_total += int(f.get("breach_total") or 0)
+            pending += int(f.get("pending") or 0)
+            if f.get("slo_ms") is not None:
+                recorded_slo = float(f["slo_ms"])
+            if f.get("window"):
+                last_win = f["window"]
+        slo = (float(slo_ms_override) if slo_ms_override is not None
+               else (recorded_slo if recorded_slo is not None
+                     else SLO_DEFAULT_MS))
+        if converged_total and (slo_ms_override is None
+                                or recorded_slo == slo):
+            within = converged_total - breach_total
+            exact = True
+        else:
+            within = h_conv.within_us(slo * 1000.0)
+            exact = False
+        attainment = (within / h_conv.count) if h_conv.count else None
+        budget = 1.0 - SLO_GOAL
+
+        def dist(h: LagHistogram) -> dict:
+            return {
+                "count": h.count,
+                "p50_ms": h.quantile_ms(0.50),
+                "p90_ms": h.quantile_ms(0.90),
+                "p95_ms": h.quantile_ms(0.95),
+                "p99_ms": h.quantile_ms(0.99),
+                "mean_ms": h.mean_ms(),
+                "max_ms": (round(h.max_us / 1000.0, 4)
+                           if h.max_us is not None else None),
+            }
+
+        replicas = []
+        rep_hists: Dict[str, LagHistogram] = {}
+        for f in self._replicas.values():
+            if epoch is not None and f.get("epoch") != epoch:
+                continue
+            h = LagHistogram.from_fields(f.get("hist"))
+            if not h.count:
+                continue
+            rep_hists.setdefault(str(f.get("replica")),
+                                 LagHistogram()).merge(h)
+        for rep, h in rep_hists.items():
+            replicas.append({
+                "replica": rep,
+                "count": h.count,
+                "p95_ms": h.quantile_ms(0.95),
+                "max_ms": (round(h.max_us / 1000.0, 4)
+                           if h.max_us is not None else None),
+            })
+        replicas.sort(key=lambda r: -(r["p95_ms"] or 0.0))
+
+        return {
+            "windows": len(windows),
+            "ops_woven": h_woven.count,
+            "ops_converged": h_conv.count,
+            "pending": pending,
+            "woven": dist(h_woven),
+            "converged": dist(h_conv),
+            "slo": {
+                "target_ms": slo,
+                "goal": SLO_GOAL,
+                "attainment": (round(attainment, 4)
+                               if attainment is not None else None),
+                "attainment_exact": exact,
+                "breaches": (breach_total if exact
+                             else (round(h_conv.count - within, 1)
+                                   if h_conv.count else 0)),
+                "burn_rate": (round((1.0 - attainment) / budget, 2)
+                              if attainment is not None else None),
+                "verdict": (None if attainment is None
+                            else ("OK" if attainment >= SLO_GOAL
+                                  else "BREACH")),
+            },
+            "window": last_win,
+            "replicas": replicas,
+        }
 
 
 def lag_summary(events: Sequence[dict],
@@ -615,99 +730,15 @@ def lag_summary(events: Sequence[dict],
     question to a broken run is "did anything record at all?".
     ``epoch`` scopes the report to one cumulative-record generation
     (:func:`current_epoch` — one in-process reset span); by default
-    every generation in the stream is summed."""
-    if epoch is not None:
-        events = [e for e in events
-                  if e.get("name") not in ("lag.window", "lag.replica")
-                  or (e.get("fields") or {}).get("epoch") == epoch]
-    windows = _last_per_pid(events, "lag.window")
-    h_woven = LagHistogram()
-    h_conv = LagHistogram()
-    converged_total = 0
-    breach_total = 0
-    pending = 0
-    recorded_slo = None
-    last_win = {}
-    for f in windows:
-        h_woven.merge(LagHistogram.from_fields(f.get("hist_woven")))
-        h_conv.merge(LagHistogram.from_fields(f.get("hist_converged")))
-        converged_total += int(f.get("converged_total") or 0)
-        breach_total += int(f.get("breach_total") or 0)
-        pending += int(f.get("pending") or 0)
-        if f.get("slo_ms") is not None:
-            recorded_slo = float(f["slo_ms"])
-        if f.get("window"):
-            last_win = f["window"]
-    slo = (float(slo_ms_override) if slo_ms_override is not None
-           else (recorded_slo if recorded_slo is not None
-                 else SLO_DEFAULT_MS))
-    if converged_total and (slo_ms_override is None
-                            or recorded_slo == slo):
-        within = converged_total - breach_total
-        exact = True
-    else:
-        within = h_conv.within_us(slo * 1000.0)
-        exact = False
-    attainment = (within / h_conv.count) if h_conv.count else None
-    budget = 1.0 - SLO_GOAL
+    every generation in the stream is summed.
 
-    def dist(h: LagHistogram) -> dict:
-        return {
-            "count": h.count,
-            "p50_ms": h.quantile_ms(0.50),
-            "p90_ms": h.quantile_ms(0.90),
-            "p95_ms": h.quantile_ms(0.95),
-            "p99_ms": h.quantile_ms(0.99),
-            "mean_ms": h.mean_ms(),
-            "max_ms": (round(h.max_us / 1000.0, 4)
-                       if h.max_us is not None else None),
-        }
-
-    replicas = []
-    rep_hists: Dict[str, LagHistogram] = {}
-    for f in _last_per_pid(events, "lag.replica",
-                           extra_keys=("replica", "gen")):
-        h = LagHistogram.from_fields(f.get("hist"))
-        if not h.count:
-            continue
-        rep_hists.setdefault(str(f.get("replica")),
-                             LagHistogram()).merge(h)
-    for rep, h in rep_hists.items():
-        replicas.append({
-            "replica": rep,
-            "count": h.count,
-            "p95_ms": h.quantile_ms(0.95),
-            "max_ms": (round(h.max_us / 1000.0, 4)
-                       if h.max_us is not None else None),
-        })
-    replicas.sort(key=lambda r: -(r["p95_ms"] or 0.0))
-
-    report = {
-        "windows": len(windows),
-        "ops_woven": h_woven.count,
-        "ops_converged": h_conv.count,
-        "pending": pending,
-        "woven": dist(h_woven),
-        "converged": dist(h_conv),
-        "slo": {
-            "target_ms": slo,
-            "goal": SLO_GOAL,
-            "attainment": (round(attainment, 4)
-                           if attainment is not None else None),
-            "attainment_exact": exact,
-            "breaches": (breach_total if exact
-                         else (round(h_conv.count - within, 1)
-                               if h_conv.count else 0)),
-            "burn_rate": (round((1.0 - attainment) / budget, 2)
-                          if attainment is not None else None),
-            "verdict": (None if attainment is None
-                        else ("OK" if attainment >= SLO_GOAL
-                              else "BREACH")),
-        },
-        "window": last_win,
-        "replicas": replicas,
-    }
-    return report
+    Implementation-wise this IS :class:`LagReducer` fed with the whole
+    stream — the batch pass and the live incremental fold share one
+    body, so they cannot drift apart."""
+    r = LagReducer()
+    for e in events:
+        r.feed(e)
+    return r.report(slo_ms_override=slo_ms_override, epoch=epoch)
 
 
 def render(report: dict) -> str:

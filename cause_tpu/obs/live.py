@@ -1,0 +1,692 @@
+"""Live telemetry: streaming aggregation + alert rules over obs
+streams AS THEY ARE PRODUCED.
+
+Every obs layer before this one (PR 1/4/5/6/9) is post-hoc: JSONL
+sidecars analyzed after the run by ``obs fleet``/``gap``/``lag``.
+ROADMAP item 4's sync service needs the opposite shape — a live
+feedback loop where the admission controller reads sliding lag and
+headroom gauges WHILE the fleet runs — and the chip-certification
+windows keep wedging invisibly at round end with no in-flight signal.
+This module is the read side running concurrently with the write side:
+
+- **the incremental fold engine** (:class:`LiveFold`) — consumes obs
+  records one at a time and maintains rolling fleet/cost/lag state:
+  the fleet-health report (documents, staleness, divergence
+  incidents, full-bag rate), the convergence-lag distributions with
+  SLO attainment + burn rate (sliding p50/p95/p99 from the mergeable
+  pow2 histograms), the wave cost totals with the
+  O(doc)-vs-O(delta) slope, ``fleet.token_headroom`` minima,
+  waves/sec, dispatch counts, and per-event-name recency (the wedge
+  signal). It is built ON the batch reducers (``FleetReducer``,
+  ``LagReducer``, ``CostReducer``), so its folds are bit-equal to the
+  post-hoc ``lag_summary``/``fleet_report``/``costmodel_digest`` on
+  the same stream — the same last-per-(pid, reset-epoch) summation
+  rules ``obs.lag`` defines;
+- **feeds** — in-process via the bounded subscriber hook on the PR-1
+  sink (:func:`attach` → :class:`LiveAttachment`), cross-process by
+  tailing one or more O_APPEND JSONL sidecars
+  (:class:`StreamTailer` / :class:`MultiTailer`, rotation-aware:
+  an inode change or truncation reopens from byte zero);
+- **the alert-rule registry** — declarative threshold / absence /
+  burn-rate rules over the snapshot (:func:`parse_rule`,
+  :func:`default_rules`: ``"burn>2"``, ``"absence:wave.digest:120"``
+  — the wedge detector — and ``"full_bag_rate>0.2"``), evaluated
+  edge-triggered by :class:`LiveMonitor`: each rule fires ONE
+  ``live.alert`` record per excursion (re-arming on recovery) and
+  invokes registered callbacks — the signal surface item 4's dynamic
+  batch-sizing controller subscribes to;
+- **periodic rollups** — ``live.snapshot`` records (compact scalar
+  summary of the fold) for the sidecar, Perfetto (named
+  ``semantic:live`` track) and the ``obs watch`` dashboard /
+  Prometheus endpoint (``cause_tpu.obs.watch``).
+
+Contract (same as the rest of ``cause_tpu.obs``): stdlib + core only,
+importable without jax/numpy. The read-side classes work with obs OFF
+(tailing someone else's sidecar needs no local recording); the
+write-side entry points are inert — :func:`attach` returns None, and
+``live.alert``/``live.snapshot`` are only ever emitted through
+``core.event`` (a no-op when disabled), so the obs-off invariance
+(no records, no env reads, no subscriber state, byte-identical
+program-cache keys) holds for the entire layer — pinned by
+tests/test_live.py. On jit-reachable paths, call sites must sit
+behind ``obs.enabled()`` guards — causelint rule OBS007 gates that.
+"""
+
+from __future__ import annotations
+
+import os
+import json
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from . import core
+from .costmodel import CostReducer
+from .fleet import FleetReducer
+
+__all__ = [
+    "LiveFold",
+    "Rule",
+    "parse_rule",
+    "default_rules",
+    "DEFAULT_RULE_SPECS",
+    "LiveMonitor",
+    "LiveAttachment",
+    "attach",
+    "StreamTailer",
+    "MultiTailer",
+    "snapshot_path",
+]
+
+# rolling waves/sec window (seconds): long enough to smooth a bursty
+# fleet, short enough that a wedge shows as the rate falling to zero
+# within a couple of dashboard refreshes
+_RATE_WINDOW_S = 60.0
+# wave timestamps retained for the rate estimate (bounded memory)
+_RATE_TS_MAX = 8192
+
+
+class LiveFold:
+    """The incremental fold engine: one obs record in, rolling state
+    updated. Wraps the batch-equal reducers (fleet/lag/counters/cost)
+    and adds the live-only axes no post-hoc report needs — event
+    recency, waves/sec, token-headroom minima. Pure read side: safe
+    to run with obs off (a monitor tailing a foreign sidecar)."""
+
+    __slots__ = ("fleet", "cost", "first_ts_us", "last_ts_us",
+                 "last_seen_us", "_wave_ts", "headroom_min",
+                 "headroom_last", "heartbeat")
+
+    def __init__(self):
+        self.fleet = FleetReducer()
+        self.cost = CostReducer()
+        self.first_ts_us: Optional[int] = None
+        self.last_ts_us: Optional[int] = None
+        # event name -> newest ts_us (the absence rules' input)
+        self.last_seen_us: Dict[str, int] = {}
+        self._wave_ts: deque = deque(maxlen=_RATE_TS_MAX)
+        # token-headroom gauges: site ("wave"/"session") -> min / last
+        self.headroom_min: Dict[str, float] = {}
+        self.headroom_last: Dict[str, float] = {}
+        # the newest run.heartbeat fields (wedge triage: which ladder
+        # item / wave stage was alive last)
+        self.heartbeat: Optional[dict] = None
+
+    def feed(self, e: dict) -> None:
+        self.fleet.feed(e)
+        self.cost.feed(e)
+        ts = e.get("ts_us")
+        if isinstance(ts, (int, float)):
+            ts = int(ts)
+            if self.first_ts_us is None or ts < self.first_ts_us:
+                self.first_ts_us = ts
+            if self.last_ts_us is None or ts > self.last_ts_us:
+                self.last_ts_us = ts
+        ev = e.get("ev")
+        name = e.get("name")
+        if ev == "event" and name:
+            if isinstance(ts, int):
+                prev = self.last_seen_us.get(name)
+                if prev is None or ts > prev:
+                    self.last_seen_us[name] = ts
+                if name == "wave.digest":
+                    self._wave_ts.append(ts)
+            if name == "run.heartbeat":
+                hb = dict(e.get("fields") or {})
+                if isinstance(ts, int):
+                    hb["ts_us"] = ts
+                self.heartbeat = hb
+        elif ev == "gauge" and isinstance(name, str) \
+                and name.startswith("fleet.token_headroom."):
+            site = name[len("fleet.token_headroom."):]
+            v = e.get("value")
+            if isinstance(v, (int, float)):
+                self.headroom_last[site] = v
+                cur = self.headroom_min.get(site)
+                self.headroom_min[site] = (v if cur is None
+                                           else min(cur, v))
+
+    def feed_many(self, events: Iterable[dict]) -> None:
+        for e in events:
+            self.feed(e)
+
+    # ------------------------------------------------------ snapshot
+
+    def now_us(self) -> int:
+        """The fold's notion of "now": wall clock, floored by the
+        newest record's timestamp so a replay of an old stream
+        (``--once``) measures ages against the stream's own end, not
+        against today."""
+        wall = time.time_ns() // 1000
+        if self.last_ts_us is not None and self.last_ts_us > wall:
+            return self.last_ts_us
+        return wall
+
+    def waves_per_s(self, now_us: int,
+                    window_s: float = _RATE_WINDOW_S) -> float:
+        cutoff = now_us - int(window_s * 1e6)
+        n = sum(1 for t in self._wave_ts if t >= cutoff)
+        return round(n / window_s, 4)
+
+    def ages_s(self, now_us: int) -> Dict[str, float]:
+        """Seconds since each event name was last seen (the absence
+        rules' axis), plus ``"any"`` — since ANY record landed."""
+        out = {name: round(max(0, now_us - ts) / 1e6, 3)
+               for name, ts in self.last_seen_us.items()}
+        if self.last_ts_us is not None:
+            out["any"] = round(max(0, now_us - self.last_ts_us) / 1e6, 3)
+        return out
+
+    def snapshot(self, now_us: Optional[int] = None) -> dict:
+        """The rolling state as one dict — the alert rules' input and
+        the dashboard's render source. Sections mirror the post-hoc
+        reports (``fleet_report``'s shape for fleet/sync/wave/gc,
+        ``lag_summary``'s for lag, ``costmodel_digest``'s for cost),
+        plus the live-only axes (rates, ages, headroom, heartbeat)."""
+        now = self.now_us() if now_us is None else int(now_us)
+        rep = self.fleet.report()
+        incidents = rep.pop("divergence_incidents")
+        snap = {
+            "ts_us": now,
+            "records": rep["events"],
+            "fleet": {
+                "documents": rep["documents"],
+                "waves": rep["waves"],
+                "pairs": rep["pairs"],
+                "replicas": rep["replicas"],
+                "agreed_documents": rep["agreed_documents"],
+                "staleness": rep["staleness"],
+                "divergence_incidents": len(incidents),
+                "last_incidents": incidents[-3:],
+            },
+            "sync": rep["sync"],
+            "wave": rep["wave"],
+            "gc": rep["gc"],
+            "lag": dict(self.fleet.lag.report()),
+            "cost": self.cost.digest(),
+            "rates": {"waves_per_s": self.waves_per_s(now)},
+            "headroom": {
+                "min": (min(self.headroom_min.values())
+                        if self.headroom_min else None),
+                "min_by_site": dict(self.headroom_min),
+                "last_by_site": dict(self.headroom_last),
+            },
+            "heartbeat": self.heartbeat,
+            "ages_s": self.ages_s(now),
+        }
+        if self.cost.waves:
+            by_path = self.cost.curves_by_path()
+            if len(by_path) > 1:
+                snap["cost"]["by_path"] = {
+                    k: v.get("verdict") for k, v in by_path.items()}
+        return snap
+
+
+# ------------------------------------------------------------- rules
+
+
+def snapshot_path(snap: dict, path: str):
+    """Resolve a dotted path (``"sync.full_bag_rate"``) into a
+    snapshot dict; None when any segment is missing."""
+    cur = snap
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+# threshold-rule aliases: the operator-facing names for the snapshot
+# paths an admission controller (and the CLI --rules flag) cares about
+RULE_ALIASES = {
+    "burn": "lag.slo.burn_rate",
+    "attainment": "lag.slo.attainment",
+    "p50": "lag.converged.p50_ms",
+    "p95": "lag.converged.p95_ms",
+    "p99": "lag.converged.p99_ms",
+    "window_p99": "lag.window.p99_ms",
+    "pending": "lag.pending",
+    "full_bag_rate": "sync.full_bag_rate",
+    "fallback_rate": "wave.fallback_rate",
+    "session_overflow": "wave.session_overflow",
+    "divergence": "fleet.divergence_incidents",
+    "headroom": "headroom.min",
+    "waves_per_s": "rates.waves_per_s",
+    "stale": "stale_s",
+}
+
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda a, b: a > b,
+    "<": lambda a, b: a < b,
+    ">=": lambda a, b: a >= b,
+    "<=": lambda a, b: a <= b,
+}
+
+
+class Rule:
+    """One declarative alert rule, edge-triggered: :meth:`check`
+    returns the alert fields exactly once per excursion (the rule
+    re-arms when the condition clears), so a persistent breach costs
+    one ``live.alert``, not one per snapshot tick — the CI smoke's
+    "forced breach fires EXACTLY ONE alert" contract.
+
+    Kinds:
+
+    - ``threshold`` — ``<path><op><value>`` over the snapshot
+      (aliases in :data:`RULE_ALIASES`); a missing/None value never
+      fires (you cannot breach a percentile that does not exist yet);
+    - ``absence`` — ``absence:<event>:<seconds>``: fires when the
+      named event has not been seen for the given span (measured
+      against the newest record when the event never appeared — a
+      stream that is producing OTHER records but no ``wave.digest``
+      is a wedged fleet, not an idle one). An empty stream never
+      fires;
+    - ``burn`` is just a threshold alias (``burn>2`` reads the SLO
+      burn rate ``lag_summary`` computes).
+    """
+
+    __slots__ = ("spec", "kind", "path", "op", "limit", "event",
+                 "window_s", "firing")
+
+    def __init__(self, spec: str, kind: str, path: str = "",
+                 op: str = ">", limit: float = 0.0, event: str = "",
+                 window_s: float = 0.0):
+        self.spec = spec
+        self.kind = kind
+        self.path = path
+        self.op = op
+        self.limit = limit
+        self.event = event
+        self.window_s = window_s
+        self.firing = False
+
+    def _condition(self, snap: dict) -> Optional[dict]:
+        if self.kind == "absence":
+            age = (snap.get("ages_s") or {}).get(self.event)
+            if age is None and snap.get("records"):
+                # never seen: judge against the stream's own span —
+                # other records flowing while this event stays absent
+                # IS the wedge shape; a silent (empty) stream is not
+                age = snap.get("span_s")
+            if age is None or age <= self.window_s:
+                return None
+            return {"age_s": age, "window_s": self.window_s,
+                    "event": self.event}
+        value = snapshot_path(snap, self.path)
+        if not isinstance(value, (int, float)):
+            return None
+        if _OPS[self.op](float(value), self.limit):
+            return {"value": value, "limit": self.limit, "op": self.op,
+                    "path": self.path}
+        return None
+
+    def check(self, snap: dict) -> Optional[dict]:
+        hit = self._condition(snap)
+        if hit is None:
+            self.firing = False
+            return None
+        if self.firing:
+            return None  # still in the same excursion
+        self.firing = True
+        hit["rule"] = self.spec
+        hit["kind"] = self.kind
+        return hit
+
+
+def parse_rule(spec: str) -> Rule:
+    """One rule from its declarative spec string (see :class:`Rule`).
+    Raises ``ValueError`` on a malformed spec — a watch run with a
+    typo'd rule must fail loudly, not silently monitor nothing."""
+    s = spec.strip()
+    if s.startswith("absence:"):
+        parts = s.split(":")
+        if len(parts) != 3:
+            raise ValueError(
+                f"absence rule must be absence:<event>:<seconds>: "
+                f"{spec!r}")
+        try:
+            window = float(parts[2])
+        except ValueError:
+            raise ValueError(f"absence window is not a number: {spec!r}")
+        return Rule(s, "absence", event=parts[1], window_s=window)
+    for op in (">=", "<=", ">", "<"):  # two-char ops first
+        if op in s:
+            path, _, raw = s.partition(op)
+            path = path.strip()
+            try:
+                limit = float(raw.strip())
+            except ValueError:
+                raise ValueError(f"threshold is not a number: {spec!r}")
+            if not path:
+                raise ValueError(f"empty snapshot path: {spec!r}")
+            return Rule(s, "threshold",
+                        path=RULE_ALIASES.get(path, path), op=op,
+                        limit=limit)
+    raise ValueError(
+        f"unrecognized rule {spec!r} (want <path><op><value> or "
+        f"absence:<event>:<seconds>)")
+
+
+# the shipped defaults: SLO burn past 2x (the error budget is being
+# eaten at least twice as fast as sustainable), the wedge detector
+# (a fleet that stopped waving for 120 s while still emitting other
+# records), and the PR-5 finding that full-bag fallbacks are the
+# dominant degradation mode
+DEFAULT_RULE_SPECS = ("burn>2", "absence:wave.digest:120",
+                      "full_bag_rate>0.2")
+
+
+def default_rules() -> List[Rule]:
+    return [parse_rule(s) for s in DEFAULT_RULE_SPECS]
+
+
+# ----------------------------------------------------------- monitor
+
+
+class LiveMonitor:
+    """The fold + the rule registry + the emit side, as one object:
+    ``feed`` records, ``evaluate`` the rules (emitting ``live.alert``
+    obs events — when obs is on — and firing callbacks), ``snapshot``
+    the rolling state (optionally emitting a ``live.snapshot``
+    record). Thread-safe: the in-process attachment polls from
+    whatever thread the caller owns while a Prometheus endpoint reads
+    snapshots from the server thread."""
+
+    def __init__(self, rules: Optional[Iterable] = None,
+                 on_alert: Iterable[Callable[[dict], None]] = (),
+                 source: str = "live"):
+        self.fold = LiveFold()
+        if rules is None:
+            self.rules = default_rules()
+        else:
+            self.rules = [r if isinstance(r, Rule) else parse_rule(r)
+                          for r in rules]
+        self.on_alert = list(on_alert)
+        self.source = str(source)
+        self.alerts: List[dict] = []
+        self.snapshots_emitted = 0
+        self._lock = threading.Lock()
+
+    def add_callback(self, fn: Callable[[dict], None]) -> None:
+        """Register an alert callback (the batch-sizing controller's
+        subscription point)."""
+        self.on_alert.append(fn)
+
+    def feed(self, events: Iterable[dict]) -> None:
+        with self._lock:
+            self.fold.feed_many(events)
+
+    def overlay_counters(self, counters: dict, gauges: dict,
+                         pid: Optional[int] = None) -> None:
+        """Overlay the in-process counter registry onto the fold
+        (same per-pid last-snapshot merge rule as a flushed
+        ``counters`` record) WITHOUT counting a stream record — the
+        fold's record count must keep matching the sidecar."""
+        with self._lock:
+            self.fold.fleet.feed_counters({
+                "ev": "counters",
+                "pid": os.getpid() if pid is None else pid,
+                "counters": counters,
+                "gauges": gauges,
+            })
+
+    def snapshot(self, now_us: Optional[int] = None) -> dict:
+        with self._lock:
+            snap = self.fold.snapshot(now_us)
+            # the absence rules' never-seen fallback axis: the span of
+            # the stream itself (see Rule._condition)
+            if self.fold.first_ts_us is not None \
+                    and self.fold.last_ts_us is not None:
+                snap["span_s"] = round(
+                    (snap["ts_us"] - self.fold.first_ts_us) / 1e6, 3)
+                # wall-clock staleness, independent of the chosen
+                # "now": a sidecar that stopped growing half an hour
+                # ago is a dead run even when --once replays it
+                # against its own recorded end (rule alias "stale")
+                snap["stale_s"] = round(max(
+                    0, time.time_ns() // 1000
+                    - self.fold.last_ts_us) / 1e6, 3)
+            snap["alerts_total"] = len(self.alerts)
+            return snap
+
+    def evaluate(self, now_us: Optional[int] = None,
+                 snap: Optional[dict] = None) -> List[dict]:
+        """Run every rule against the (given or fresh) snapshot;
+        returns the alerts that fired on THIS call (edge-triggered —
+        an unchanged excursion returns nothing)."""
+        if snap is None:
+            snap = self.snapshot(now_us)
+        fired: List[dict] = []
+        # rule state (edge-trigger flags) mutates under the monitor
+        # lock: two threads evaluating through one excursion must not
+        # both see firing=False and double-emit — "exactly one alert
+        # per excursion" is a contract, not a best effort. Emission
+        # and callbacks run OUTSIDE the lock (a callback may touch
+        # the monitor).
+        with self._lock:
+            for rule in self.rules:
+                hit = rule.check(snap)
+                if hit is None:
+                    continue
+                hit["ts_us"] = snap["ts_us"]
+                hit["source"] = self.source
+                self.alerts.append(hit)
+                fired.append(hit)
+        for hit in fired:
+            if core.enabled():
+                core.event("live.alert", **hit)
+                core.counter("live.alerts").inc()
+            for fn in self.on_alert:
+                try:
+                    fn(hit)
+                except Exception:  # noqa: BLE001 - telemetry never raises
+                    pass
+        return fired
+
+    def emit_snapshot(self, now_us: Optional[int] = None) -> dict:
+        """One compact ``live.snapshot`` record into the obs stream
+        (no-op emit when obs is off; the dict is returned either
+        way). Compact on purpose: the rollup is a dashboard row, not
+        a dump of the whole fold."""
+        snap = self.snapshot(now_us)
+        lag = snap.get("lag") or {}
+        conv = lag.get("converged") or {}
+        slo = lag.get("slo") or {}
+        cost = snap.get("cost") or {}
+        fields = {
+            "source": self.source,
+            "records": snap["records"],
+            "documents": snap["fleet"]["documents"],
+            "waves": snap["fleet"]["waves"],
+            "agreed_documents": snap["fleet"]["agreed_documents"],
+            "divergence_incidents":
+                snap["fleet"]["divergence_incidents"],
+            "waves_per_s": snap["rates"]["waves_per_s"],
+            "full_bag_rate": snap["sync"]["full_bag_rate"],
+            "ops_converged": lag.get("ops_converged", 0),
+            "pending": lag.get("pending", 0),
+            "p50_ms": conv.get("p50_ms"),
+            "p95_ms": conv.get("p95_ms"),
+            "p99_ms": conv.get("p99_ms"),
+            "slo_ms": slo.get("target_ms"),
+            "attainment": slo.get("attainment"),
+            "burn_rate": slo.get("burn_rate"),
+            "verdict": slo.get("verdict"),
+            "dispatches": cost.get("dispatches", 0),
+            "headroom_min": snap["headroom"]["min"],
+            "alerts_total": snap["alerts_total"],
+        }
+        if core.enabled():
+            core.event("live.snapshot", **fields)
+            with self._lock:
+                self.snapshots_emitted += 1
+        return snap
+
+
+# ------------------------------------------------- in-process attach
+
+
+class LiveAttachment:
+    """A live monitor wired to THIS process's obs sink via the PR-1
+    subscriber hook: :meth:`poll` drains the bounded queue into the
+    fold, overlays the in-process counter registry (counters only
+    reach the stream at ``flush()`` — a live reader must not wait for
+    one), evaluates the rules and optionally emits a snapshot.
+    Detach with :meth:`close`."""
+
+    __slots__ = ("sub", "monitor")
+
+    def __init__(self, sub, monitor: LiveMonitor):
+        self.sub = sub
+        self.monitor = monitor
+
+    def poll(self, emit_snapshot: bool = False,
+             evaluate: bool = True) -> dict:
+        """Drain + fold + (evaluate, snapshot). Returns the fresh
+        snapshot dict (its ``alerts_total`` includes anything fired
+        by this call)."""
+        self.monitor.feed(self.sub.drain())
+        snap_regs = core.counters_snapshot()
+        if snap_regs["counters"] or snap_regs["gauges"]:
+            # flush-equivalent overlay: same per-pid last-snapshot
+            # merge rule, sourced from the registry instead of the
+            # stream, and NOT counted as a record (the fold's record
+            # count keeps matching the sidecar)
+            self.monitor.overlay_counters(snap_regs["counters"],
+                                          snap_regs["gauges"])
+        if evaluate:
+            self.monitor.evaluate()
+        if emit_snapshot:
+            return self.monitor.emit_snapshot()
+        return self.monitor.snapshot()
+
+    @property
+    def dropped(self) -> int:
+        """Records the bounded queue dropped (a stalled poller)."""
+        return self.sub.dropped
+
+    @property
+    def closed(self) -> bool:
+        """True once detached — including by an ``obs.reset()`` /
+        ``configure(reset=True)``, which drops every subscriber with
+        the rest of the obs state. A closed attachment drains nothing
+        forever; the holder must re-``attach()`` against the new
+        state (and decide what to do with the fold so far)."""
+        return self.sub.closed
+
+    def close(self) -> None:
+        core.unsubscribe(self.sub)
+
+
+def attach(rules: Optional[Iterable] = None,
+           on_alert: Iterable[Callable[[dict], None]] = (),
+           maxlen: int = 8192,
+           source: str = "live") -> Optional[LiveAttachment]:
+    """Attach a live monitor to this process's obs sink. Returns None
+    when obs is disabled — the obs-off contract is zero subscriber
+    state, zero records, zero overhead."""
+    sub = core.subscribe(maxlen)
+    if sub is None:
+        return None
+    return LiveAttachment(sub, LiveMonitor(rules=rules,
+                                           on_alert=on_alert,
+                                           source=source))
+
+
+# ------------------------------------------------------------- tails
+
+
+class StreamTailer:
+    """Tail one O_APPEND JSONL sidecar: :meth:`poll` returns the
+    records appended since the last poll. Rotation-aware — an inode
+    change or a truncation (size < position) reopens from byte zero,
+    so a log-rotated or restarted writer is picked up without
+    restarting the watcher. Torn trailing lines (a writer mid-append)
+    stay buffered until their newline lands; garbage lines are
+    skipped like every other obs reader."""
+
+    __slots__ = ("path", "_fh", "_ino", "_pos", "_buf")
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._fh = None
+        self._ino = None
+        self._pos = 0
+        self._buf = b""
+
+    def _close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                pass
+        self._fh = None
+        self._ino = None
+        self._pos = 0
+        self._buf = b""
+
+    def poll(self) -> List[dict]:
+        try:
+            st = os.stat(self.path)
+        except OSError:
+            # not created yet (or rotated away mid-poll): wait for it
+            self._close()
+            return []
+        if self._fh is None or st.st_ino != self._ino \
+                or st.st_size < self._pos:
+            self._close()
+            try:
+                self._fh = open(self.path, "rb")
+            except OSError:
+                return []
+            self._ino = os.fstat(self._fh.fileno()).st_ino
+        out: List[dict] = []
+        try:
+            self._fh.seek(self._pos)
+            data = self._fh.read()
+        except (OSError, ValueError):
+            self._close()
+            return []
+        self._pos += len(data)
+        self._buf += data
+        lines = self._buf.split(b"\n")
+        self._buf = lines.pop()  # torn tail waits for its newline
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                out.append(obj)
+        return out
+
+    def close(self) -> None:
+        self._close()
+
+
+class MultiTailer:
+    """Several sidecars as one feed (a multi-process soak's
+    per-process streams): each :meth:`poll` batch is merged by record
+    timestamp across files — the same stable rule ``load_streams``
+    applies to whole files, at poll-batch granularity."""
+
+    __slots__ = ("tailers",)
+
+    def __init__(self, paths: Iterable[str]):
+        self.tailers = [StreamTailer(p) for p in paths]
+
+    def poll(self) -> List[dict]:
+        out: List[dict] = []
+        for t in self.tailers:
+            out.extend(t.poll())
+        if len(self.tailers) > 1:
+            out.sort(key=lambda e: e.get("ts_us") or 0)
+        return out
+
+    def close(self) -> None:
+        for t in self.tailers:
+            t.close()

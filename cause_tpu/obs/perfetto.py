@@ -31,7 +31,7 @@ import json
 from typing import Iterable, List, Optional
 
 __all__ = ["to_chrome_trace", "export_perfetto", "load_jsonl",
-           "load_streams", "merged_final_counters"]
+           "load_streams", "CountersReducer", "merged_final_counters"]
 
 # synthetic-tid base for the named semantic tracks: far above any real
 # OS thread id's low bits mattering for display, stable across runs so
@@ -85,27 +85,49 @@ def load_streams(paths: Iterable[str]) -> List[dict]:
     return events
 
 
+class CountersReducer:
+    """Incremental form of :func:`merged_final_counters`: feed obs
+    records one at a time (a live tail), read the merged totals at any
+    point. Counter snapshots are cumulative PER PROCESS, so the state
+    is each pid's LAST snapshot; :meth:`totals` sums across pids in
+    first-seen-pid order — the identical fold the batch function runs,
+    so the two are bit-equal on the same stream."""
+
+    __slots__ = ("_per_pid", "include_gauges")
+
+    def __init__(self, include_gauges: bool = False):
+        self._per_pid: dict = {}
+        self.include_gauges = include_gauges
+
+    def feed(self, e: dict) -> None:
+        if e.get("ev") != "counters":
+            return
+        merged = dict(e.get("counters") or {})
+        if self.include_gauges:
+            merged.update(e.get("gauges") or {})
+        self._per_pid[e.get("pid", 0)] = merged
+
+    def totals(self) -> dict:
+        out: dict = {}
+        for snap in self._per_pid.values():
+            for name, value in snap.items():
+                out[name] = out.get(name, 0) + value
+        return out
+
+
 def merged_final_counters(events: Iterable[dict],
                           include_gauges: bool = False) -> dict:
     """The stream's final counter values: counter snapshots are
     cumulative PER PROCESS, so keep each pid's LAST snapshot and sum
     across pids (a shared sidecar interleaves parent + abandoned-child
     flushes — last-wins across pids would report whichever process
-    flushed last). The one merge rule shared by ``--summary`` and the
-    ledger's devprof digest."""
-    per_pid: dict = {}
+    flushed last). The one merge rule shared by ``--summary``, the
+    ledger's devprof digest and the live fold (which runs the same
+    body incrementally via :class:`CountersReducer`)."""
+    r = CountersReducer(include_gauges=include_gauges)
     for e in events:
-        if e.get("ev") != "counters":
-            continue
-        merged = dict(e.get("counters") or {})
-        if include_gauges:
-            merged.update(e.get("gauges") or {})
-        per_pid[e.get("pid", 0)] = merged
-    out: dict = {}
-    for snap in per_pid.values():
-        for name, value in snap.items():
-            out[name] = out.get(name, 0) + value
-    return out
+        r.feed(e)
+    return r.totals()
 
 
 def _args_of(e: dict) -> dict:

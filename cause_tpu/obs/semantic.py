@@ -75,6 +75,15 @@ SEMANTIC_EVENT_PREFIXES = (
     "tree.",
     "op.",
     "lag.",
+    # PR 10: the live-telemetry read side's own vocabulary
+    # (``live.snapshot`` periodic rollups, ``live.alert`` rule
+    # firings) and the run heartbeats (``run.heartbeat`` from wave
+    # dispatch, sync rounds, harvest ladder items, soak rounds) —
+    # each renders as its own named Perfetto track, so a wedge
+    # investigation reads alert/heartbeat swim-lanes directly above
+    # the spans that stalled
+    "live.",
+    "run.",
 )
 
 

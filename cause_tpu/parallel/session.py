@@ -434,6 +434,12 @@ class FleetSession:
             from ..obs import costmodel as _cm
 
             _cm.wave_begin("session")
+            # wedge-triage heartbeat: before the dispatch, so a live
+            # monitor can pair "session wave started" with the
+            # wave.digest that should follow (see parallel/wave.py)
+            obs.event("run.heartbeat", stage="session.wave",
+                      uuid=str(self.pairs[0][0].ct.uuid),
+                      pairs=len(self.pairs))
         with obs.span("session.wave", pairs=len(self.pairs),
                       u_max=int(self.u_max)):
             r, v, _c, ov = batched_merge_weave_v5(
@@ -608,6 +614,8 @@ class FleetSession:
             from ..obs import costmodel as _cm
 
             _cm.wave_begin("session")
+            obs.event("run.heartbeat", stage="session.delta_wave",
+                      uuid=str(self.pairs[0][0].ct.uuid), pairs=B)
         with obs.span("session.delta_wave", pairs=B, w_cap=int(wcap)):
             with obs.span("session.delta_assemble"):
                 lanes, starts, counts = assemble_delta_window(

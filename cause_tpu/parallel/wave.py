@@ -540,6 +540,13 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
         from ..obs import costmodel as _cm
 
         _cm.wave_begin("wave")
+        # wedge triage heartbeat (PR 10): lands BEFORE the device
+        # dispatch, so a live monitor distinguishes "a wave started
+        # and never produced its wave.digest" (wedged dispatch) from
+        # "nobody is waving" (idle) — the obs watch absence rules
+        # read exactly this pairing
+        obs.event("run.heartbeat", stage="wave",
+                  uuid=str(pairs[0][0].ct.uuid), pairs=len(pairs))
     for a, b in pairs:
         s.check_mergeable(a.ct, b.ct)
 

@@ -232,6 +232,12 @@ def sync_stream(handle, stream):
     deadlock the symmetric protocol.
     """
     ct = handle.ct
+    if obs.enabled():
+        # wedge-triage heartbeat (PR 10): before the first blocking
+        # exchange, so a live monitor can tell "a sync round started
+        # and hung mid-protocol" from "no replica is syncing" —
+        # the obs watch absence rules read this pairing
+        obs.event("run.heartbeat", stage="sync.stream", uuid=ct.uuid)
     hello = exchange_frame(stream, {
         "op": "hello", "uuid": ct.uuid, "type": ct.type,
         "vv": version_vector(handle),
@@ -322,6 +328,8 @@ def sync_pair(a, b) -> Tuple[object, object]:
     """In-memory anti-entropy between two handles (the loopback twin of
     ``sync_stream`` — same vv/delta/full-bag-fallback path, no
     framing)."""
+    if obs.enabled():
+        obs.event("run.heartbeat", stage="sync.pair", uuid=a.ct.uuid)
     va, vb = version_vector(a), version_vector(b)
 
     def one_way(dst, src, dst_vv):

@@ -1142,10 +1142,21 @@ def main() -> None:
             emit(ev="skip", item=name)
             continue
         emit(ev="start", item=name)
+        # wedge-triage heartbeat (PR 10): one record at every ladder
+        # -item boundary, so `obs watch` over the harvest sidecar can
+        # tell WHICH item a wedged tunnel round died inside (and how
+        # long it had been running) without ssh archaeology — the
+        # tunnel_watcher `watch` mode reads exactly these
+        obs.event("run.heartbeat", item=name, stage="start",
+                  elapsed=round(time.monotonic() - T0, 1))
         try:
             with obs.span("harvest.item", item=name):
                 fn(*args)
+            obs.event("run.heartbeat", item=name, stage="done",
+                      elapsed=round(time.monotonic() - T0, 1))
         except Exception as e:  # noqa: BLE001 - emit + try next item
+            obs.event("run.heartbeat", item=name, stage="error",
+                      elapsed=round(time.monotonic() - T0, 1))
             emit(ev="error", item=name,
                  error=f"{type(e).__name__}: {str(e)[:300]}")
 
@@ -1174,6 +1185,8 @@ def main() -> None:
     # waves, user code) ships the winner with no human in the loop.
     if record_state:
         decide_defaults(done, results, plat, suspects=suspect_values)
+    obs.event("run.heartbeat", item="ladder", stage="done",
+              elapsed=round(time.monotonic() - T0, 1))
     emit(ev="done", complete=complete, platform=plat)
     obs.flush()
 

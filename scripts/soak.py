@@ -472,6 +472,13 @@ def main():
         obs.counter("soak.rounds").inc()
         if done % 25 == 0:
             print(f"soak: {done} rounds clean (seed {seed})", flush=True)
+            # liveness heartbeat for `obs watch` over the sidecar: a
+            # soak that stops minting these has wedged, one that keeps
+            # minting them while lag pends is merely slow (PR 10)
+            obs.event("run.heartbeat", stage="soak", rounds=done,
+                      seed=seed,
+                      elapsed=round(time.monotonic()
+                                    - (deadline - args.minutes * 60), 1))
     done_fields = dict(rounds=done, seed0=args.seed0, last_seed=seed)
     if obs.enabled() and args.obs_out:
         # the soak's cost-model aggregate (waves, dispatches, delta

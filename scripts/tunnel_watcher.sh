@@ -21,6 +21,7 @@
 #   tunnel_watcher.sh queue   [--hours H] [--wait-stages]
 #   tunnel_watcher.sh harvest --round rN [--hours H] [--certified]
 #                             [--fast-resume] [--rc3-backoff SECS]
+#   tunnel_watcher.sh watch   --round rN [--follow] [--interval S]
 #
 # queue mode (round-3 measurement queue): waits for run_queue.sh
 # (plus probe_v5_stages.py with --wait-stages) to finish, then keeps
@@ -36,6 +37,14 @@
 # skips the inter-attempt sleep after a success (windows are ~6 min);
 # --rc3-backoff adds the ADVICE r5 #4 long back-off after a claimguard
 # pre-compile hard-exit.
+#
+# watch mode (PR 10): render the round's live-telemetry view from the
+# obs sidecar harvest mode now streams
+# (measurements/obs_harvest_<round>.jsonl) — one `obs watch --once`
+# snapshot by default (heartbeat recency + staleness tell a WEDGED
+# round from a slow one without ssh archaeology), a live ANSI
+# dashboard with --follow. Takes no claimant lock: it is a pure
+# reader and must work WHILE a harvest watcher holds the tunnel.
 set -u
 cd "$(dirname "$0")/.."
 mkdir -p measurements
@@ -48,6 +57,8 @@ ROUND=""
 CERTIFIED=0
 FAST_RESUME=0
 RC3_BACKOFF=0
+FOLLOW=0
+INTERVAL=5
 while [ $# -gt 0 ]; do
   case "$1" in
     --hours)        HOURS="$2"; shift 2 ;;
@@ -56,6 +67,8 @@ while [ $# -gt 0 ]; do
     --certified)    CERTIFIED=1; shift ;;
     --fast-resume)  FAST_RESUME=1; shift ;;
     --rc3-backoff)  RC3_BACKOFF="$2"; shift 2 ;;
+    --follow)       FOLLOW=1; shift ;;
+    --interval)     INTERVAL="$2"; shift 2 ;;
     *) echo "tunnel_watcher: unknown arg $1" >&2; exit 2 ;;
   esac
 done
@@ -185,8 +198,15 @@ harvest_mode() {
     # Phase 1: the kernel ladder harvest (self-skips completed items)
     if [ ! -e "measurements/harvest_tpu_${ROUND}.ok" ]; then
       note "attempt $i: harvest"
+      # --obs-out: stream the ladder's run.heartbeat / harvest.* /
+      # wave evidence into the round's live sidecar, so
+      # `tunnel_watcher.sh watch --round $ROUND` (from any other
+      # shell, no lock) can tell a wedged item from a slow one. The
+      # sidecar is O_APPEND across attempts, like the logs.
       HARVEST_CLAIM_DEADLINE=$(claim_remain) \
-        python -u scripts/harvest.py >> "measurements/harvest_tpu_${ROUND}.log" \
+        python -u scripts/harvest.py \
+        --obs-out "measurements/obs_harvest_${ROUND}.jsonl" \
+        >> "measurements/harvest_tpu_${ROUND}.log" \
         2>> "measurements/harvest_tpu_${ROUND}.err" 9>&-
       rc=$?
       note "attempt $i: harvest rc=$rc"
@@ -278,8 +298,33 @@ print(harvest.certified_env())")
   note "done"
 }
 
+# ---------------------------------------------------------- watch mode
+watch_mode() {
+  [ -n "$ROUND" ] || { echo "tunnel_watcher: watch needs --round" >&2; exit 2; }
+  STREAM="measurements/obs_harvest_${ROUND}.jsonl"
+  if [ ! -e "$STREAM" ]; then
+    echo "tunnel_watcher: no live sidecar at $STREAM yet" >&2
+    echo "tunnel_watcher: (harvest mode writes it; is the round's watcher running?)" >&2
+    exit 2
+  fi
+  # wedge rules tuned to ladder cadence: a harvest item that has not
+  # heartbeat'd in 30 min is wedged (the longest items — full-size
+  # bench bursts — finish well inside that), and a sidecar that
+  # stopped GROWING for 15 min means the whole claimant is dead.
+  # wave.digest absence is deliberately NOT armed here: a ladder
+  # window legitimately spends long stretches in non-wave items.
+  if [ "$FOLLOW" = 1 ]; then
+    exec python -m cause_tpu.obs watch "$STREAM" \
+      --rules "absence:run.heartbeat:1800" --rules "stale>900" \
+      --interval "$INTERVAL"
+  fi
+  exec python -m cause_tpu.obs watch "$STREAM" \
+    --rules "absence:run.heartbeat:1800" --rules "stale>900" --once
+}
+
 case "$MODE" in
   queue)   queue_mode ;;
   harvest) harvest_mode ;;
-  *) echo "usage: tunnel_watcher.sh {queue|harvest} [options]" >&2; exit 2 ;;
+  watch)   watch_mode ;;
+  *) echo "usage: tunnel_watcher.sh {queue|harvest|watch} [options]" >&2; exit 2 ;;
 esac

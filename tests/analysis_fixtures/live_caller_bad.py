@@ -1,0 +1,72 @@
+"""Known-bad OBS007 fixture: live-telemetry APIs on a traced path.
+Only the unguarded calls gate — every OBS003-OBS006 guard spelling
+(nested if, aliased import, early return, negated-test else) is
+sanctioned here too, and generic verbs (``m.feed``/``m.poll``) on
+non-live objects must never be flagged."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu.obs import live
+from cause_tpu.obs import live as _live
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    live.attach()                                     # OBS007: unguarded
+    if obs.enabled():
+        att = live.attach()                           # guarded: fine
+        att.poll()
+    if _obs_enabled():
+        # the aliased module spelling is fine under the aliased guard
+        _live.LiveMonitor(rules=["burn>2"])
+    return x * 2
+
+
+@jax.jit
+def traced_bare_name(x):
+    # distinctive bare names gate without a module qualifier too
+    from cause_tpu.obs.live import LiveMonitor
+
+    LiveMonitor()                                     # OBS007: unguarded
+    return x + 1
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    live.attach(rules=["full_bag_rate>0.2"])
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful live call), its ELSE branch is obs-on
+    # only (guarded: fine)
+    if not obs.enabled():
+        live.attach()                                 # OBS007
+    else:
+        live.attach()                                 # fine
+    return x
+
+
+class _NotLive:
+    def feed(self, xs):
+        return xs
+
+    def poll(self):
+        return []
+
+
+@jax.jit
+def traced_generic_verbs_ok(x):
+    # feed()/poll() on an arbitrary object are NOT live APIs — the
+    # rule matches the live module qualifier or distinctive names only
+    m = _NotLive()
+    m.feed([1, 2])
+    m.poll()
+    return x

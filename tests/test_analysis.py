@@ -154,6 +154,23 @@ def test_lag_unguarded_call_on_traced_path():
     assert rules_of(res) == ["OBS006"]
 
 
+def test_live_unguarded_call_on_traced_path():
+    """OBS007 (PR-10): the live-telemetry layer drains subscriber
+    queues, folds records and evaluates alert rules when obs is on —
+    jit-reachable code must gate it behind obs.enabled(). Exactly
+    three findings — the plain unguarded call, a distinctive bare
+    name, and the body of a negated test; every OBS003-OBS006 guard
+    spelling is sanctioned, and generic verbs (feed/poll) on non-live
+    objects never flag."""
+    res = run_api(os.path.join(FIX, "live_caller_bad.py"))
+    obs7 = [f for f in res.findings if f.rule == "OBS007"]
+    assert len(obs7) == 3, [f.message for f in obs7]
+    assert "attach" in obs7[0].message
+    assert "LiveMonitor" in obs7[1].message
+    assert "attach" in obs7[2].message
+    assert rules_of(res) == ["OBS007"]
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -268,7 +285,7 @@ def test_cli_exit_codes():
     "tid_bad.py", "jph_bad.py", os.path.join("obs", "obs_bad.py"),
     "obs_caller_bad.py", "devprof_caller_bad.py",
     "semantic_caller_bad.py", "costmodel_caller_bad.py",
-    "lag_caller_bad.py", "lca_bad.py",
+    "lag_caller_bad.py", "live_caller_bad.py", "lca_bad.py",
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -279,7 +296,7 @@ def test_cli_list_rules():
     assert out.returncode == 0
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
-                "OBS006", "LCA001", "GEN001"):
+                "OBS006", "OBS007", "LCA001", "GEN001"):
         assert rid in out.stdout
 
 
