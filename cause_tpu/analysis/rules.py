@@ -637,7 +637,8 @@ def check_obs003(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
 
 
 _SEMANTIC_APIS = frozenset(
-    {"sync_applied", "sync_full_bag", "observe_wave",
+    {"sync_applied", "sync_full_bag", "sync_rejected",
+     "sync_quarantined", "sync_readmitted", "observe_wave",
      "session_overflow", "token_headroom", "gc_compacted",
      "lazy_materialized", "fleet_report"}
 )
@@ -787,6 +788,54 @@ def check_obs007(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                     "drains subscriber queues, folds records and "
                     "evaluates alert rules when obs is on; gate the "
                     "call (or hoist it off the traced path)")
+
+
+# distinctive bare names for the chaos-engine hooks and the recovery
+# ladder's telemetry; generic spellings are matched through their
+# module qualifier. ``run_dispatch``/``is_transient`` are SANCTIONED
+# unguarded — run_dispatch IS the dispatch path (its idle cost is one
+# chaos.enabled() read and a try frame), and the `enabled` spellings
+# are the guard itself.
+_CHAOS_APIS = frozenset(
+    {"mangle_items", "dispatch_fault", "budget_exhaust",
+     "should_crash", "stall_point", "chaos_report",
+     "restore_recorded"}
+)
+_CHS_SANCTIONED = frozenset({"run_dispatch", "is_transient",
+                             "suspended"})
+
+
+@rule("CHS001",
+      "chaos/recovery API reached from jit-reachable code without a "
+      "chaos.enabled()/obs.enabled() guard (fault hooks draw RNG and "
+      "take the engine lock; recovery telemetry assembles event "
+      "payloads the moment obs is on)")
+def check_chs001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module) or "chaos" in module.segments \
+            or module.segments[-1] == "recovery":
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]) \
+                    or parts[-1] in _CHS_SANCTIONED:
+                continue
+            is_chs = (
+                parts[-1] in _CHAOS_APIS
+                or any(p in ("chaos", "_chaos", "recovery",
+                             "_recovery") for p in parts[:-1])
+            )
+            if is_chs and not guarded:
+                yield _finding(
+                    "CHS001", module, call,
+                    f"{'.'.join(parts)}() on a jit-reachable path "
+                    "without a chaos.enabled()/obs.enabled() guard — "
+                    "fault hooks advance seeded RNG streams under the "
+                    "engine lock and recovery telemetry builds event "
+                    "payloads when enabled; gate the call (or hoist "
+                    "it off the traced path)")
 
 
 # ----------------------------------------------------------------- LCA

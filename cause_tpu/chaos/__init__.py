@@ -1,0 +1,401 @@
+"""Seeded, deterministic fault injection for the sync/wave substrate.
+
+Network-accelerated replication systems treat fault injection as table
+stakes (arXiv:1605.05619): you do not find out how a fleet degrades by
+waiting for the tunnel to corrupt a frame. This engine injects the
+substrate's real failure modes ON PURPOSE, from a seeded plan, so every
+recovery path in the repo is exercised deterministically and evidenced
+in the obs stream:
+
+- **payload** faults mangle a sync delta's on-wire node triples
+  (``corrupt`` / ``truncate`` / ``duplicate`` / ``reorder`` / ``drop``)
+  — caught by ``sync.py``'s validate-before-apply boundary
+  (``sync.reject`` events, repeat offenders quarantined);
+- **dispatch** faults fail a device dispatch (``raise``: a transient
+  :class:`InjectedDispatchError` the recovery ladder retries;
+  ``exhaust``: a window-budget exhaustion that forces the delta path
+  back to full width) — caught by ``parallel/recovery.py``;
+- **crash** faults tell a harness to kill and restart a replica
+  process-equivalent (drop the ``FleetSession``, restore from its
+  serde checkpoint, losing all in-memory state) — ``scripts/soak.py
+  --chaos`` acts on :func:`should_crash`;
+- **stall** faults sleep inside a wave to trip the PR-10
+  ``absence:run.heartbeat`` live-alert rule (the wedge detector).
+
+Determinism: every fault spec keeps its own per-site invocation
+counter and its own ``random.Random((plan seed, spec index))`` stream,
+so the same plan over the same call sequence injects the same faults
+at the same points — the repro contract (seed, plan) -> identical
+fault schedule.
+
+Off-invariance contract (the obs contract, verbatim): with
+``CAUSE_TPU_CHAOS`` unset (or ``0``), :func:`enabled` is False, every
+hook returns its input immediately, no state is kept, no plan file is
+read, no records are minted anywhere, and program-cache keys are
+byte-identical (pinned in tests/test_chaos.py). Enable with
+``CAUSE_TPU_CHAOS=<plan.json path>`` (or an inline JSON object), or
+programmatically with :func:`configure` for tests.
+
+Stdlib-only, importable without jax/numpy (the obs rule).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional
+
+__all__ = [
+    "FAMILIES",
+    "InjectedDispatchError",
+    "enabled",
+    "configure",
+    "reset",
+    "suspended",
+    "mangle_items",
+    "dispatch_fault",
+    "budget_exhaust",
+    "should_crash",
+    "stall_point",
+    "injected",
+    "chaos_report",
+]
+
+FAMILIES = ("payload", "dispatch", "crash", "stall")
+PAYLOAD_MODES = ("corrupt", "truncate", "duplicate", "reorder", "drop")
+# the value planted by payload corruption: tests and the chaos soak
+# gate grep converged documents for it — an admitted corruption is a
+# validation hole, not a flake
+CORRUPT_MARKER = "⚡chaos-corrupt⚡"
+_TRUTHY = ("1", "true", "yes")
+_LOG_MAX = 4096          # injected-fault log bound (drops counted)
+_STALL_CAP_S = 5.0       # no plan may wedge a run for real
+
+
+class InjectedDispatchError(RuntimeError):
+    """A chaos-injected transient device-dispatch failure. The
+    recovery ladder classifies it as transient and retries with
+    backoff; nothing else in the repo raises it."""
+
+
+class _Fault:
+    """One armed fault spec (see the plan schema in scripts/soak.py):
+    family/site/mode plus a firing schedule — explicit invocation
+    indices (``at``), a seeded probability (``prob``), and an optional
+    total-fire cap (``times``)."""
+
+    __slots__ = ("family", "site", "mode", "at", "prob", "times",
+                 "ms", "seq", "fired", "rng")
+
+    def __init__(self, spec: dict, seed: int, index: int):
+        self.family = str(spec.get("family", ""))
+        if self.family not in FAMILIES:
+            raise ValueError(f"unknown chaos family: {self.family!r}")
+        self.site = str(spec.get("site", "*"))
+        self.mode = str(spec.get("mode", ""))
+        if self.family == "payload":
+            self.mode = self.mode or "corrupt"
+            if self.mode not in PAYLOAD_MODES:
+                raise ValueError(
+                    f"unknown payload mode: {self.mode!r}")
+        elif self.family == "dispatch":
+            self.mode = self.mode or "raise"
+            if self.mode not in ("raise", "exhaust"):
+                raise ValueError(
+                    f"unknown dispatch mode: {self.mode!r}")
+        self.at = frozenset(int(x) for x in (spec.get("at") or ()))
+        self.prob = float(spec.get("prob") or 0.0)
+        self.times = int(spec.get("times") or 0)
+        self.ms = float(spec.get("ms") or 0.0)
+        self.seq = 0
+        self.fired = 0
+        # one independent deterministic stream per spec: firing of
+        # spec i never perturbs spec j's schedule. Stable int seed on
+        # purpose (str hash() is process-salted; tuple seeding is
+        # deprecated) — (plan seed, spec index, family) all mix in.
+        self.rng = random.Random(
+            int(seed) * 1_000_003 + int(index) * 7_919
+            + zlib.crc32(self.family.encode()))
+
+    def matches(self, site: str) -> bool:
+        return self.site == "*" or self.site == site \
+            or site.startswith(self.site + ".")
+
+    def decide(self) -> bool:
+        """One invocation at a matching site: advance the per-spec
+        counter and report whether this invocation WOULD inject.
+        ``fired`` is charged by the caller for the winning spec only —
+        a spec that hits but loses the invocation to an earlier spec
+        must not consume its ``times`` cap on a fault it never
+        injected. Called under the engine lock."""
+        self.seq += 1
+        if self.times and self.fired >= self.times:
+            return False
+        hit = self.seq in self.at
+        if not hit and self.prob:
+            # drawn EVERY invocation so the stream stays aligned with
+            # the invocation counter regardless of earlier outcomes
+            hit = self.rng.random() < self.prob
+        return hit
+
+
+class _State:
+    __slots__ = ("enabled", "faults", "log", "dropped", "lock",
+                 "suspend_depth", "seed")
+
+    def __init__(self, enabled_: bool, plan: Optional[dict]):
+        self.enabled = bool(enabled_) and plan is not None
+        self.seed = int((plan or {}).get("seed", 0))
+        self.faults: List[_Fault] = [
+            _Fault(spec, self.seed, i)
+            for i, spec in enumerate((plan or {}).get("faults") or ())
+        ]
+        self.log: List[dict] = []
+        self.dropped = 0
+        self.lock = threading.Lock()
+        self.suspend_depth = 0
+
+
+_STATE: Optional[_State] = None
+_STATE_LOCK = threading.Lock()
+
+
+def _load_plan(raw: str) -> dict:
+    raw = raw.strip()
+    if raw.startswith("{"):
+        return json.loads(raw)
+    with open(raw) as f:
+        return json.load(f)
+
+
+def _resolve_state() -> _State:
+    global _STATE
+    st = _STATE
+    if st is None:
+        with _STATE_LOCK:
+            st = _STATE
+            if st is None:
+                raw = os.environ.get("CAUSE_TPU_CHAOS", "").strip()
+                if not raw or raw.lower() in ("0", "false", "no"):
+                    st = _State(False, None)
+                else:
+                    # a broken plan fails loudly: silently running
+                    # without the faults you asked for is the one
+                    # outcome a chaos harness must never have
+                    st = _State(True, _load_plan(raw))
+                _STATE = st
+    return st
+
+
+def configure(plan: Optional[dict] = None,
+              enabled: Optional[bool] = None,
+              reset: bool = False) -> None:
+    """Arm (or disarm) the engine programmatically — the soak harness
+    and tests. ``reset=True`` drops all engine state and re-reads the
+    environment on next use."""
+    global _STATE
+    with _STATE_LOCK:
+        if reset:
+            _STATE = None
+            if plan is None and enabled is None:
+                return
+        if plan is not None:
+            _STATE = _State(True if enabled is None else enabled, plan)
+            return
+    st = _resolve_state()
+    if enabled is not None:
+        st.enabled = bool(enabled) and bool(st.faults)
+
+
+def reset() -> None:
+    """Drop all chaos state; re-read ``CAUSE_TPU_CHAOS`` on next use."""
+    configure(reset=True)
+
+
+def enabled() -> bool:
+    st = _resolve_state()
+    return st.enabled and st.suspend_depth == 0
+
+
+class suspended:
+    """Context manager: chaos is inert inside the block WITHOUT
+    consuming any fault-spec invocation counters — the soak's
+    fault-free oracle replays the same ops through the same call
+    sites and must not perturb (or suffer) the fault schedule."""
+
+    def __enter__(self):
+        st = _resolve_state()
+        with st.lock:
+            st.suspend_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        st = _resolve_state()
+        with st.lock:
+            st.suspend_depth = max(0, st.suspend_depth - 1)
+        return False
+
+
+def _decide(site: str, family: str,
+            mode: Optional[str] = None) -> Optional[_Fault]:
+    st = _resolve_state()
+    if not (st.enabled and st.suspend_depth == 0):
+        return None
+    with st.lock:
+        hit = None
+        for f in st.faults:
+            if f.family != family or not f.matches(site):
+                continue
+            if mode is not None and f.mode != mode:
+                # mode-specific hooks never advance (or consume) a
+                # different mode's schedule: raise-specs tick only at
+                # dispatch_fault, exhaust-specs only at budget_exhaust
+                continue
+            # every matching spec advances (determinism: counters
+            # depend on the call sequence, not on other specs'
+            # outcomes); the first hit wins the invocation
+            if f.decide() and hit is None:
+                hit = f
+        if hit is not None:
+            hit.fired += 1
+        return hit
+
+
+def _record(f: _Fault, site: str, **details) -> None:
+    st = _resolve_state()
+    rec = {"family": f.family, "site": site, "mode": f.mode,
+           "seq": f.seq, "ts_us": time.time_ns() // 1000}
+    rec.update(details)
+    with st.lock:
+        if len(st.log) >= _LOG_MAX:
+            st.dropped += 1
+        else:
+            st.log.append(rec)
+    # evidence in the ledgered stream — through obs, so chaos-without
+    # -obs still injects (detection evidence is the recovery side's
+    # job) and obs-off keeps its zero-records contract
+    from .. import obs
+
+    if obs.enabled():
+        obs.counter(f"chaos.injected.{f.family}").inc()
+        obs.event("chaos.inject", **rec)
+
+
+# ------------------------------------------------------------- hooks
+
+
+def mangle_items(items: list, site: str = "sync.delta") -> list:
+    """Maybe-mangled copy of an encoded node-triple payload (the
+    ``serde.encode_node_items`` wire form). Returns ``items``
+    unchanged (same object) when no payload fault fires; empty
+    payloads never consume a firing (there is nothing to corrupt)."""
+    if not items:
+        return items
+    f = _decide(site, "payload")
+    if f is None:
+        return items
+    out = [list(it) for it in items]
+    idx = f.rng.randrange(len(out))
+    mode = f.mode
+    if mode == "corrupt":
+        out[idx][2] = CORRUPT_MARKER
+    elif mode == "truncate":
+        out[idx] = out[idx][:2]
+    elif mode == "duplicate":
+        dup = [out[idx][0], out[idx][1], CORRUPT_MARKER]
+        out.insert(idx + 1, dup)
+    elif mode == "reorder":
+        if len(out) >= 2:
+            out[0], out[-1] = out[-1], out[0]
+        else:
+            out[idx][2] = CORRUPT_MARKER
+            mode = "corrupt"
+    elif mode == "drop":
+        del out[idx]
+    _record(f, site, nodes=len(items), index=idx, applied=mode)
+    return out
+
+
+def dispatch_fault(site: str) -> None:
+    """A ``dispatch``-family fault in ``raise`` mode: raise the
+    transient :class:`InjectedDispatchError` (the recovery ladder's
+    retry input). ``exhaust``-mode specs are read by
+    :func:`budget_exhaust` instead and never fire here."""
+    f = _decide(f"{site}.dispatch", "dispatch", mode="raise")
+    if f is None:
+        return
+    _record(f, site)
+    raise InjectedDispatchError(
+        f"chaos: injected dispatch failure at {site} "
+        f"(seq {f.seq})")
+
+
+def budget_exhaust(site: str) -> bool:
+    """A ``dispatch``-family fault in ``exhaust`` mode: report a
+    window-budget exhaustion (the caller drops its delta frontier and
+    runs the full-width ladder rung)."""
+    f = _decide(f"{site}.budget", "dispatch", mode="exhaust")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def should_crash(site: str) -> bool:
+    """Whether a ``crash`` fault fires at this point — the HARNESS
+    acts on it (drop the session, restore from checkpoint); the
+    engine only schedules and records."""
+    f = _decide(site, "crash")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def stall_point(site: str) -> float:
+    """Sleep a ``stall`` fault's ``ms`` (capped) inside a wave —
+    enough to trip the live ``absence:run.heartbeat`` rule in a
+    watching monitor. Returns the seconds actually slept (0.0 when
+    nothing fired)."""
+    f = _decide(site, "stall")
+    if f is None:
+        return 0.0
+    dur = min(max(f.ms, 0.0) / 1000.0, _STALL_CAP_S)
+    _record(f, site, stall_ms=round(dur * 1000.0, 3))
+    if dur:
+        time.sleep(dur)
+    return dur
+
+
+# ------------------------------------------------------------ report
+
+
+def injected() -> List[dict]:
+    """A copy of the injected-fault log (bounded; ``chaos_report``
+    counts drops)."""
+    st = _resolve_state()
+    with st.lock:
+        return [dict(r) for r in st.log]
+
+
+def chaos_report() -> dict:
+    """The engine's own accounting: total injections, by family, by
+    site/mode — the soak gate compares this against the DETECTED side
+    (sync.reject, recovery events) so an injected-but-undetected
+    fault fails loudly."""
+    st = _resolve_state()
+    with st.lock:
+        log = [dict(r) for r in st.log]
+        dropped = st.dropped
+    by_family: Dict[str, int] = {}
+    by_site: Dict[str, int] = {}
+    for r in log:
+        by_family[r["family"]] = by_family.get(r["family"], 0) + 1
+        key = f"{r['site']}:{r['mode']}" if r.get("mode") else r["site"]
+        by_site[key] = by_site.get(key, 0) + 1
+    return {"injected": len(log), "dropped": dropped,
+            "by_family": by_family, "by_site": by_site, "log": log}

@@ -141,6 +141,10 @@ class FleetReducer:
         overflow = counters.get("wave.overflow_retry", 0)
         examined = counters.get("gc.nodes_examined", 0)
         reclaimed = counters.get("gc.nodes_reclaimed", 0)
+        rejects = counters.get("sync.reject", 0)
+        quarantines = counters.get("sync.quarantine", 0)
+        readmits = counters.get("sync.readmit", 0)
+        rec_steps = counters.get("recovery.steps", 0)
 
         out = {
             "events": self.records,
@@ -158,6 +162,13 @@ class FleetReducer:
                 "full_bag": full_bag,
                 "full_bag_rate": _rate(full_bag,
                                        delta_rounds + full_bag),
+                # PR 11: validate-before-apply rejects and the replica
+                # quarantine they escalate to (quarantined = entries
+                # minus re-admissions — the CURRENT quarantine count)
+                "rejects": rejects,
+                "quarantines": quarantines,
+                "readmits": readmits,
+                "quarantined": max(0, quarantines - readmits),
             },
             "wave": {
                 "pairs": wave_pairs,
@@ -167,6 +178,25 @@ class FleetReducer:
                 "overflow_retries": overflow,
                 "session_overflow":
                     counters.get("fleet.session_overflow", 0),
+            },
+            # PR 11: the recovery ladder's evidence — every declared
+            # delta->full->double_budget->host transition, retries of
+            # transient dispatch failures, checkpoint restores, and
+            # the storm axis (steps per wave) the live alert reads
+            "recovery": {
+                "steps": rec_steps,
+                "by_step": {
+                    step: counters[f"recovery.step.{step}"]
+                    for step in ("full", "double_budget", "host")
+                    if counters.get(f"recovery.step.{step}")
+                },
+                "retries": counters.get("recovery.retry", 0),
+                "exhausted": counters.get("recovery.exhausted", 0),
+                "restores": counters.get("recovery.restores", 0),
+                "chaos_injected": sum(
+                    v for k, v in counters.items()
+                    if k.startswith("chaos.injected.")),
+                "per_wave": _rate(rec_steps, self._waves),
             },
             "gc": {
                 "runs": counters.get("gc.runs", 0),
@@ -242,12 +272,30 @@ def render(report: dict) -> str:
         f"  sync: {s['delta_rounds']} delta round(s) "
         f"({s['delta_nodes']} nodes), {s['full_bag']} full-bag "
         f"fallback(s) ({100 * s['full_bag_rate']:.1f}%)")
+    if s.get("rejects") or s.get("quarantines"):
+        lines.append(
+            f"  ingest: {s['rejects']} payload reject(s), "
+            f"{s['quarantines']} quarantine(s), {s['readmits']} "
+            f"readmission(s) ({s['quarantined']} replica(s) "
+            f"quarantined now)")
     w = report["wave"]
     lines.append(
         f"  waves: {w['pairs']} pair-merges, {w['fallback']} host "
         f"fallback(s) ({100 * w['fallback_rate']:.1f}%), "
         f"{w['poisoned']} poisoned, {w['overflow_retries']} overflow "
         f"retrie(s), {w['session_overflow']} session overflow(s)")
+    rec = report.get("recovery") or {}
+    if rec.get("steps") or rec.get("retries") or rec.get("restores") \
+            or rec.get("chaos_injected"):
+        by = ", ".join(f"{k}: {v}" for k, v in
+                       (rec.get("by_step") or {}).items())
+        lines.append(
+            f"  recovery: {rec['steps']} ladder step(s)"
+            + (f" ({by})" if by else "")
+            + f", {rec['retries']} retrie(s), "
+              f"{rec['restores']} restore(s), "
+              f"{rec['chaos_injected']} chaos fault(s) injected "
+              f"({rec['per_wave']:.2f} step(s)/wave)")
     g = report["gc"]
     lines.append(
         f"  gc: {g['runs']} run(s), {g['nodes_examined']} examined, "

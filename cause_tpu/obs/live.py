@@ -203,6 +203,7 @@ class LiveFold:
             "sync": rep["sync"],
             "wave": rep["wave"],
             "gc": rep["gc"],
+            "recovery": rep["recovery"],
             "lag": dict(self.fleet.lag.report()),
             "cost": self.cost.digest(),
             "rates": {"waves_per_s": self.waves_per_s(now)},
@@ -254,6 +255,13 @@ RULE_ALIASES = {
     "headroom": "headroom.min",
     "waves_per_s": "rates.waves_per_s",
     "stale": "stale_s",
+    # PR 11: the chaos/recovery axes — rejected ingest payloads, the
+    # current replica-quarantine count, and the recovery-storm rate
+    # (declared ladder steps per wave)
+    "rejects": "sync.rejects",
+    "quarantined": "sync.quarantined",
+    "recovery_per_wave": "recovery.per_wave",
+    "recovery_retries": "recovery.retries",
 }
 
 _OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -371,10 +379,15 @@ def parse_rule(spec: str) -> Rule:
 # the shipped defaults: SLO burn past 2x (the error budget is being
 # eaten at least twice as fast as sustainable), the wedge detector
 # (a fleet that stopped waving for 120 s while still emitting other
-# records), and the PR-5 finding that full-bag fallbacks are the
-# dominant degradation mode
+# records), the PR-5 finding that full-bag fallbacks are the dominant
+# degradation mode, and the PR-11 robustness pair — ANY replica
+# sitting in quarantine is an operator page (a corrupt or hostile
+# peer is being refused), and a recovery STORM (more than one
+# declared ladder step per wave, sustained) means the fleet is paying
+# O(doc) degradations every round instead of riding the delta path
 DEFAULT_RULE_SPECS = ("burn>2", "absence:wave.digest:120",
-                      "full_bag_rate>0.2")
+                      "full_bag_rate>0.2", "quarantined>0",
+                      "recovery_per_wave>1")
 
 
 def default_rules() -> List[Rule]:
@@ -515,6 +528,8 @@ class LiveMonitor:
             "verdict": slo.get("verdict"),
             "dispatches": cost.get("dispatches", 0),
             "headroom_min": snap["headroom"]["min"],
+            "quarantined": snap["sync"].get("quarantined", 0),
+            "recovery_steps": snap["recovery"].get("steps", 0),
             "alerts_total": snap["alerts_total"],
         }
         if core.enabled():

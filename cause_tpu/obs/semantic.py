@@ -51,6 +51,9 @@ __all__ = [
     "reset",
     "sync_applied",
     "sync_full_bag",
+    "sync_rejected",
+    "sync_quarantined",
+    "sync_readmitted",
     "observe_wave",
     "observe_tree_level",
     "session_overflow",
@@ -84,6 +87,13 @@ SEMANTIC_EVENT_PREFIXES = (
     # the spans that stalled
     "live.",
     "run.",
+    # PR 11: the chaos/recovery pairing — every injected fault
+    # (``chaos.inject``) and every recovery-ladder transition
+    # (``recovery.step``/``retry``/``restore``) on its own named
+    # track, so a chaos soak reads as inject -> detect -> recover
+    # swim-lanes over the wave spans they disrupted
+    "chaos.",
+    "recovery.",
 )
 
 
@@ -110,12 +120,48 @@ def sync_applied(n_nodes: int, path: str, uuid: str = "") -> None:
 def sync_full_bag(reason: str, uuid: str = "") -> None:
     """The prefix-gap fallback fired: the whole bag of nodes is being
     exchanged instead of a delta. ``reason`` is ``"cause-must-exist"``
-    (our merge rejected the peer's delta) or ``"peer-resync"`` (the
-    peer rejected ours and asked for the bag)."""
+    (our merge rejected the peer's delta), ``"peer-resync"`` (the
+    peer rejected ours and asked for the bag),
+    ``"payload-reject"`` (validate-before-apply refused the delta) or
+    ``"quarantined"`` (the peer is serving its re-admission resync)."""
     if not core.enabled():
         return
     core.counter("sync.full_bag").inc()
     core.event("sync.full_bag", reason=reason,
+               **({"uuid": uuid} if uuid else {}))
+
+
+def sync_rejected(why: str, uuid: str = "", peer: str = "") -> None:
+    """Validate-before-apply refused a sync payload at the ingest
+    boundary (PR 11): the document is untouched, the round degrades
+    to a full-bag resync, and this is the DETECTION evidence the
+    chaos soak gates injected payload faults against."""
+    if not core.enabled():
+        return
+    core.counter("sync.reject").inc()
+    core.event("sync.reject", why=why,
+               **{k: v for k, v in (("uuid", uuid), ("peer", peer))
+                  if v})
+
+
+def sync_quarantined(peer: str, uuid: str = "", rejects: int = 0) -> None:
+    """A repeat offender crossed the consecutive-reject threshold and
+    is quarantined out of delta exchanges and device waves until a
+    clean full-bag resync re-admits it."""
+    if not core.enabled():
+        return
+    core.counter("sync.quarantine").inc()
+    core.event("sync.quarantine", peer=peer, rejects=int(rejects),
+               **({"uuid": uuid} if uuid else {}))
+
+
+def sync_readmitted(peer: str, uuid: str = "") -> None:
+    """A quarantined replica served a clean validated full-bag resync
+    and is back in the delta/wave fast paths."""
+    if not core.enabled():
+        return
+    core.counter("sync.readmit").inc()
+    core.event("sync.readmit", peer=peer,
                **({"uuid": uuid} if uuid else {}))
 
 

@@ -9,6 +9,7 @@ from .mesh import (  # noqa: F401
     sharded_merge_weave_v4,
     sharded_merge_weave_v5,
 )
+from . import recovery  # noqa: F401
 from .session import FleetSession  # noqa: F401
 from .tree import (  # noqa: F401
     flat_fold,

@@ -1,0 +1,162 @@
+"""The explicit recovery ladder: one declared degradation policy for
+every device dispatch seam.
+
+Before PR 11 the substrate's degradation story was real but IMPLICIT —
+scattered, un-evidenced fallbacks: the session silently bounced
+delta -> full on a domain violation, merge_wave silently doubled its
+token budget on overflow and silently host-merged rows that still
+overflowed, the tree silently bounced a level to full width. Correct,
+but invisible: an operator watching the obs stream could not tell a
+healthy fleet from one quietly degrading to O(doc) every wave, and a
+transient device failure (one flaky dispatch) killed the whole wave
+instead of being retried.
+
+This module reifies that policy as ONE named ladder shared by the
+session, tree and merge_wave dispatch sites:
+
+    delta -> full -> double_budget -> host
+
+- :func:`step` is the evidence: every rung transition emits one
+  ``recovery.step`` event (site, from/to rung, reason) plus counters,
+  so the fleet CLI / live monitor can rate-alert on recovery storms —
+  obs-off it is a no-op (call sites keep the obs-guard idiom);
+- :func:`run_dispatch` is the execution seam: it runs one device
+  dispatch with the chaos engine's injected faults applied and
+  bounded retry + linear backoff on TRANSIENT failures (chaos'
+  ``InjectedDispatchError``, runtime-classified XLA transport errors)
+  — a flaky dispatch costs a retry, not the wave, while a failure
+  that survives every retry propagates loudly (with
+  ``recovery.exhausted`` evidence) rather than silently degrading.
+  Healthy-path cost is one ``chaos.enabled()`` read and a try frame
+  (measured <1% of wave wall, PERF.md "Round 11").
+
+The ladder is POLICY, not mechanism: the rungs' implementations stay
+where they always lived (session/_full_wave, wave.dispatch_full_rows'
+doubled budget, merge_wave's host fallback); this module names the
+transitions and makes every one observable.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple
+
+from .. import chaos as _chaos
+from .. import obs
+
+__all__ = [
+    "LADDER",
+    "MAX_RETRIES",
+    "BACKOFF_S",
+    "step",
+    "is_transient",
+    "run_dispatch",
+    "restore_recorded",
+]
+
+# the rungs, in degradation order; "host" is the pure-weaver host
+# fallback — always correct, never fast
+LADDER: Tuple[str, ...] = ("delta", "full", "double_budget", "host")
+
+# bounded retry for transient device failures: a real device flake is
+# either gone on the second try or it is not transient
+MAX_RETRIES = 2
+BACKOFF_S = 0.02
+
+# exception type NAMES classified as transient device failures —
+# jaxlib types cannot be imported here (obs-layer modules stay
+# importable without jax), and an isinstance against the chaos error
+# covers the injected family
+_TRANSIENT_NAMES = frozenset({"XlaRuntimeError"})
+
+
+def step(site: str, from_step: str, to_step: str, reason: str,
+         uuid: str = "", **extra) -> None:
+    """Record one ladder transition (``recovery.step`` event +
+    per-rung counter). No-op with obs off — call sites keep the
+    obs-guard idiom so causelint CHS001 can gate jit-reachable
+    paths."""
+    if not obs.enabled():
+        return
+    obs.counter("recovery.steps").inc()
+    obs.counter(f"recovery.step.{to_step}").inc()
+    fields = {"site": site, "from": from_step, "to": to_step,
+              "reason": reason}
+    if uuid:
+        fields["uuid"] = uuid
+    if extra:
+        fields.update(extra)
+    obs.event("recovery.step", **fields)
+
+
+def is_transient(exc: BaseException) -> bool:
+    """Whether a dispatch failure is worth retrying: the chaos
+    engine's injected transient, or a runtime-classified XLA
+    transport error. Everything else (shape errors, CausalError,
+    OOM) propagates immediately — retrying a deterministic failure
+    just burns the backoff."""
+    if isinstance(exc, _chaos.InjectedDispatchError):
+        return True
+    return type(exc).__name__ in _TRANSIENT_NAMES
+
+
+def run_dispatch(site: str, fn: Callable, *,
+                 retries: int = MAX_RETRIES,
+                 backoff_s: float = BACKOFF_S,
+                 uuid: str = ""):
+    """Execute one device dispatch through the ladder's retry rung:
+    chaos dispatch faults are injected here (so every dispatch seam
+    is injectable by construction), transient failures retry up to
+    ``retries`` times with linear backoff (``recovery.retry``
+    events), and exhaustion emits ``recovery.exhausted`` before
+    re-raising. A failure that survives every retry is NOT absorbed:
+    it propagates and the wave fails loudly with the ``recovery.
+    exhausted`` evidence in the stream — a device that fails the
+    same dispatch three times is not transient, and silently
+    degrading to the host rung on an unclassified error would mask
+    real defects (the ladder's other rungs handle the *declared*
+    degradations: domain violations, budget overflows, quarantine).
+
+    Sanctioned unguarded (causelint CHS001 skips it): this IS the
+    dispatch path, and its idle cost is one ``chaos.enabled()`` read
+    plus a try frame."""
+    attempt = 0
+    while True:
+        try:
+            if _chaos.enabled():
+                _chaos.dispatch_fault(site)
+            return fn()
+        except Exception as e:  # noqa: BLE001 - classified below
+            if not is_transient(e):
+                raise
+            if attempt >= retries:
+                if obs.enabled():
+                    obs.counter("recovery.exhausted").inc()
+                    obs.event("recovery.exhausted", site=site,
+                              attempts=attempt + 1,
+                              error=type(e).__name__,
+                              **({"uuid": uuid} if uuid else {}))
+                raise
+            attempt += 1
+            if obs.enabled():
+                obs.counter("recovery.retry").inc()
+                obs.event("recovery.retry", site=site, attempt=attempt,
+                          error=type(e).__name__,
+                          **({"uuid": uuid} if uuid else {}))
+            if backoff_s:
+                time.sleep(backoff_s * attempt)
+
+
+def restore_recorded(site: str, pairs: int, delta_restored: bool,
+                     uuid: str = "") -> None:
+    """Evidence of a checkpoint restore (``recovery.restore``): a
+    crashed process came back and resumed — with its delta frontier
+    when ``delta_restored`` (the steady-state resume the checkpoint
+    exists for), without it when the frontier failed revalidation
+    (the next wave re-establishes at full width)."""
+    if not obs.enabled():
+        return
+    obs.counter("recovery.restores").inc()
+    obs.event("recovery.restore", site=site, pairs=int(pairs),
+              delta_restored=bool(delta_restored),
+              **({"uuid": uuid} if uuid else {}))

@@ -56,11 +56,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import chaos as _chaos
 from .. import obs
 from ..collections import shared as s
 from ..weaver import lanecache
 from ..weaver.arrays import next_pow2
 from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
+from . import recovery as _recovery
 from .wave import (WaveBuffers, _PAD, _assemble_rows, _digest_fn,
                    _observe_semantics, _sampled_body_spotcheck,
                    assemble_delta_window, delta_domain_ok)
@@ -160,6 +162,9 @@ class FleetSession:
         self._delta_enabled = bool(delta)
         self._delta = None
         self._delta_failures = 0
+        # the last wave's fetched digests: checkpoint() serializes
+        # them and restore() gates on recomputing them bit-identically
+        self._last_digest = None
         self._full_upload(pairs)
 
     _DELTA_FAILURE_LIMIT = 3
@@ -244,17 +249,34 @@ class FleetSession:
             devprof.sample_device_memory("session.upload")
 
     # ------------------------------------------------------------------
+    def _degrade(self, pairs, reason: str):
+        """The update-level ``delta -> full`` recovery-ladder rung:
+        every full re-upload taken from the delta path is a declared,
+        evidenced transition (``recovery.step``), not a silent
+        bounce."""
+        if obs.enabled():
+            _recovery.step(
+                "session", "delta", "full", reason,
+                uuid=str(pairs[0][0].ct.uuid) if pairs else "")
+        return self._full_upload(pairs)
+
     def update(self, pairs: Sequence[Tuple[object, object]]):
         """Ship this wave's edits. Appends ride the delta path; anything
         else (dropped caches, oversized deltas, capacity growth) falls
         back to a full re-upload."""
         pairs = list(pairs)
+        # an update invalidates the checkpointable state until the
+        # next wave: the resident pairs (and possibly capacity) move
+        # ahead of the last wave's rank/visibility/digest arrays, and
+        # a checkpoint mixing the two could never pass restore's
+        # digest gate
+        self._last_digest = None
         with obs.span("session.update", pairs=len(pairs)):
             return self._update_inner(pairs)
 
     def _update_inner(self, pairs):
         if len(pairs) != len(self._views):
-            return self._full_upload(pairs)
+            return self._degrade(pairs, "pair-count-change")
         views = self._collect_views(pairs)
         if views is None:
             raise s.CausalError(
@@ -264,7 +286,7 @@ class FleetSession:
         if views[0][0].interner.generation != self._gen:
             # rank reassignment since upload: resident lo/sg packs are
             # old-generation, deltas would be new-generation
-            return self._full_upload(pairs)
+            return self._degrade(pairs, "rank-reassignment")
         B = len(pairs)
         cap = self.capacity
         d_max = self.d_max
@@ -283,23 +305,23 @@ class FleetSession:
                 n0 = int(self._uploaded_n[r, t])
                 if (v.arena is not ov.arena and ov.arena.nodes[:n0]
                         != v.arena.nodes[:n0]):
-                    return self._full_upload(pairs)  # rewritten history
+                    return self._degrade(pairs, "rewritten-history")
                 if v.n < n0 or v.n - n0 > d_max or v.n > cap:
-                    return self._full_upload(pairs)
+                    return self._degrade(pairs, "delta-overflow")
                 # an append that stabbed an old interior lane
                 # restructures the uploaded prefix's segment ordinals —
                 # the resident seg lane would be silently stale
                 if not np.array_equal(
                         v.segments()["run_of_lane"][:n0],
                         self._uploaded_rol[r][t][:n0]):
-                    return self._full_upload(pairs)
+                    return self._degrade(pairs, "interior-stab")
             segs_a, segs_b = va.segments(), vb.segments()
             ka = int(segs_a["sg_len"].shape[0])
             kb = int(segs_b["sg_len"].shape[0])
             s_needed = max(s_needed, ka + kb)
         s_max = self.dev["sg_len"].shape[1]
         if s_needed > s_max:
-            return self._full_upload(pairs)
+            return self._degrade(pairs, "segment-overflow")
 
         # delta path committed from here on. The sampled append-only
         # body check runs once per round: here on the delta path, or
@@ -379,6 +401,14 @@ class FleetSession:
                         break
                 if not ok:
                     obs.counter("session.delta_wave_invalidate").inc()
+                    if obs.enabled():
+                        # the splice stays valid; only the delta-WAVE
+                        # capability drops — the next wave runs the
+                        # full rung and re-establishes
+                        _recovery.step(
+                            "session", "delta", "full",
+                            "domain-violation",
+                            uuid=str(pairs[0][0].ct.uuid), pair=r)
                     self._delta = None
                     break
 
@@ -417,6 +447,22 @@ class FleetSession:
         First contact, domain violations, window-budget overflow, and
         every update()-level fallback run the full-width kernel
         instead, and a full wave re-establishes the frontier."""
+        if _chaos.enabled():
+            # the injectable seams: a stall fault sleeps here (the
+            # heartbeat-absence wedge shape), a budget-exhaust fault
+            # drops the delta frontier exactly like a real window
+            # -budget exhaustion would — the declared ladder handles
+            # both, bit-identically
+            _chaos.stall_point("session")
+            if self._delta is not None \
+                    and _chaos.budget_exhaust("session"):
+                obs.counter("session.delta_wave_invalidate").inc()
+                if obs.enabled():
+                    _recovery.step(
+                        "session", "delta", "full",
+                        "budget-exhaustion",
+                        uuid=str(self.pairs[0][0].ct.uuid))
+                self._delta = None
         if self._delta is not None:
             out = self._delta_wave()
             if out is not None:
@@ -442,10 +488,12 @@ class FleetSession:
                       pairs=len(self.pairs))
         with obs.span("session.wave", pairs=len(self.pairs),
                       u_max=int(self.u_max)):
-            r, v, _c, ov = batched_merge_weave_v5(
-                *(self.dev[k] for k in LANE_KEYS5),
-                u_max=self.u_max, k_max=self.u_max,
-            )
+            r, v, _c, ov = _recovery.run_dispatch(
+                "session",
+                lambda: batched_merge_weave_v5(
+                    *(self.dev[k] for k in LANE_KEYS5),
+                    u_max=self.u_max, k_max=self.u_max,
+                ))
             digest = _digest_fn()(self.dev["hi"], self.dev["lo"], r, v)
             if obs.enabled():
                 from ..obs import costmodel as _cm
@@ -506,6 +554,7 @@ class FleetSession:
             self._last_update_full = False
         if self._delta_enabled:
             self._establish_delta(r, v)
+        self._last_digest = out
         return out
 
     # ----------------------------------------------- delta-native wave
@@ -622,10 +671,12 @@ class FleetSession:
                     self._views, dstate["s"], dstate["anchor"],
                     wcap, n_w)
             r0 = dstate["s"].astype(np.int32) - 1
-            rank_w, vis_w, digest, ovf = jaxwd.batched_delta_weave(
-                *(jnp.asarray(lanes[k]) for k in LANE_KEYS5),
-                jnp.asarray(dstate["prefix_digest"]),
-                jnp.asarray(r0), u_max=n_w, k_max=n_w)
+            rank_w, vis_w, digest, ovf = _recovery.run_dispatch(
+                "session",
+                lambda: jaxwd.batched_delta_weave(
+                    *(jnp.asarray(lanes[k]) for k in LANE_KEYS5),
+                    jnp.asarray(dstate["prefix_digest"]),
+                    jnp.asarray(r0), u_max=n_w, k_max=n_w))
             out = np.asarray(digest)
             if bool(np.asarray(ovf).any()):  # pragma: no cover -
                 # structurally unreachable at u_max = N_w; kept so a
@@ -635,6 +686,9 @@ class FleetSession:
                 if obs.enabled():
                     from ..obs import costmodel as _cm
 
+                    _recovery.step("session", "delta", "full",
+                                   "window-overflow",
+                                   uuid=str(self.pairs[0][0].ct.uuid))
                     _cm.wave_abandon()
                 return None
             self.last_rank, self.last_visible = jaxwd.splice_ranks(
@@ -670,6 +724,7 @@ class FleetSession:
             )
             self._last_delta_lanes = 0
             self._last_update_full = False
+        self._last_digest = out
         return out
 
     def converge(self, tree: bool = True,
@@ -706,3 +761,225 @@ class FleetSession:
             np.zeros(len(self.pairs), np.uint32), {}, "v5",
         )
         return res.merged(i)
+
+    # --------------------------------------------- checkpoint/restore
+
+    CHECKPOINT_VERSION = 1
+
+    def checkpoint(self) -> dict:
+        """Serialize the session's resident state to one JSON-able
+        dict: the replica pairs (serde's tagged node-bag encoding),
+        the last wave's rank/visibility/digest arrays, and the delta
+        frontier. A process that crashes after a checkpoint restores
+        with :meth:`restore` and resumes STEADY-STATE DELTA WAVES —
+        no full-width re-weave, no O(doc) frontier re-establishment
+        fetch; the restore pays one lane upload plus one digest
+        dispatch (the bit-identity gate). Requires at least one
+        completed wave (the checkpointed state IS a wave's output)."""
+        from .. import serde
+
+        if self._last_digest is None or not hasattr(self, "last_rank"):
+            raise s.CausalError(
+                "nothing to checkpoint: the resident state is not a "
+                "wave's output (run a wave first; an update since "
+                "the last wave also invalidates it)",
+                {"causes": {"no-wave"}},
+            )
+        with obs.span("session.checkpoint", pairs=len(self.pairs)):
+            obs.counter("session.checkpoint").inc()
+            ck = {
+                "~causal_session": self.CHECKPOINT_VERSION,
+                "d_max": int(self.d_max),
+                "u_headroom": float(self._u_headroom),
+                "delta_enabled": bool(self._delta_enabled),
+                "u_max": int(self.u_max),
+                "capacity": int(self.capacity),
+                "pairs": [[serde.to_data(a), serde.to_data(b)]
+                          for a, b in self.pairs],
+                "rank": _pack_arr(np.asarray(self.last_rank)),
+                "visible": _pack_arr(np.asarray(self.last_visible)),
+                "digest": _pack_arr(np.asarray(self._last_digest)),
+            }
+            if self._delta is not None:
+                ck["delta"] = {
+                    "s": _pack_arr(self._delta["s"]),
+                    "anchor": _pack_arr(self._delta["anchor"]),
+                    "prefix_digest":
+                        _pack_arr(self._delta["prefix_digest"]),
+                    "w_cap": int(self._delta["w_cap"]),
+                }
+            return ck
+
+    def checkpoint_to(self, path: str) -> None:
+        """``checkpoint()`` straight to a JSON file (atomic rename so
+        a crash mid-write never leaves a torn checkpoint)."""
+        import json
+        import os
+
+        blob = json.dumps(self.checkpoint())
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+    @classmethod
+    def restore(cls, data) -> "FleetSession":
+        """Rebuild a session from :meth:`checkpoint` output (the dict,
+        or a path to a ``checkpoint_to`` file). The restore is GATED
+        on digest bit-identity: the uploaded lanes plus the restored
+        rank/visibility must reproduce the checkpoint's digests
+        exactly (one digest dispatch), or the restore refuses
+        (``causes {"checkpoint-mismatch"}``) rather than resume from
+        state it cannot prove. The delta frontier is revalidated
+        host-side against the rebuilt views; if it no longer holds
+        the session restores WITHOUT it (the next wave runs the full
+        rung and re-establishes — correct, evidenced, just O(doc))."""
+        import json as _json
+
+        from .. import serde
+
+        if isinstance(data, str):
+            with open(data) as f:
+                data = _json.load(f)
+        if not (isinstance(data, dict)
+                and data.get("~causal_session") == cls.CHECKPOINT_VERSION):
+            raise s.CausalError(
+                "not a FleetSession checkpoint (or unknown version)",
+                {"causes": {"checkpoint-mismatch"},
+                 "version": (data or {}).get("~causal_session")
+                 if isinstance(data, dict) else None},
+            )
+        with obs.span("session.restore"):
+            pairs = [(serde.from_data(ea), serde.from_data(eb))
+                     for ea, eb in data["pairs"]]
+            obj = cls.__new__(cls)
+            obj.d_max = int(data["d_max"])
+            obj._bufs = WaveBuffers()
+            obj._views = []
+            obj._uploaded_n = None
+            obj._uploaded_k = None
+            obj.capacity = 0
+            # pre-seed the restored budget: _full_upload keeps the max,
+            # so the session compiles the same program shapes it had
+            obj.u_max = int(data["u_max"])
+            obj._u_headroom = float(data["u_headroom"])
+            obj.dev = None
+            obj._last_delta_lanes = 0
+            obj._last_update_full = False
+            obj._delta_enabled = bool(data["delta_enabled"])
+            obj._delta = None
+            obj._delta_failures = 0
+            obj._last_digest = None
+            for a, b in pairs:
+                s.check_mergeable(a.ct, b.ct)
+            obj._full_upload(pairs)
+            if obj.capacity != int(data["capacity"]):
+                raise s.CausalError(
+                    "checkpoint capacity mismatch (divergent rebuild)",
+                    {"causes": {"checkpoint-mismatch"},
+                     "expected": int(data["capacity"]),
+                     "got": int(obj.capacity)},
+                )
+            B = len(pairs)
+            try:
+                rank = _unpack_arr(data["rank"])
+                visible = _unpack_arr(data["visible"])
+                want = _unpack_arr(data["digest"])
+            except (KeyError, TypeError, ValueError) as e:
+                # corrupted pack (torn base64, bad dtype): refuse
+                # through the same declared gate, never a bare numpy
+                # error
+                raise s.CausalError(
+                    "checkpoint arrays undecodable",
+                    {"causes": {"checkpoint-mismatch"},
+                     "why": str(e)},
+                ) from None
+            shape = (B, 2 * obj.capacity)
+            if rank.shape != shape or visible.shape != shape \
+                    or want.shape != (B,):
+                raise s.CausalError(
+                    "checkpoint array shapes do not match the fleet",
+                    {"causes": {"checkpoint-mismatch"}},
+                )
+            obj.last_rank = jnp.asarray(rank)
+            obj.last_visible = jnp.asarray(visible)
+            obj.last_overflow = jnp.zeros(B, bool)
+            # THE restore gate: the rebuilt lanes + the checkpointed
+            # weave outputs must reproduce the checkpointed digests
+            # bit-for-bit — one digest dispatch, no full wave
+            got = np.asarray(_digest_fn()(
+                obj.dev["hi"], obj.dev["lo"],
+                obj.last_rank, obj.last_visible))
+            if not np.array_equal(got, want):
+                raise s.CausalError(
+                    "checkpoint digest mismatch: refusing to resume "
+                    "from unprovable state",
+                    {"causes": {"checkpoint-mismatch"},
+                     "rows": np.flatnonzero(got != want).tolist()},
+                )
+            obj._last_digest = got
+            delta_restored = False
+            dck = data.get("delta")
+            if dck is not None and obj._delta_enabled:
+                frontier = {
+                    "s": _unpack_arr(dck["s"]),
+                    "anchor": _unpack_arr(dck["anchor"]),
+                    "prefix_digest": _unpack_arr(dck["prefix_digest"]),
+                    "w_cap": int(dck["w_cap"]),
+                }
+                if obj._frontier_valid(frontier):
+                    obj._delta = frontier
+                    delta_restored = True
+                else:
+                    obs.counter("session.restore_frontier_drop").inc()
+            if obs.enabled():
+                _recovery.restore_recorded(
+                    "session", B, delta_restored,
+                    uuid=str(pairs[0][0].ct.uuid))
+            return obj
+
+    def _frontier_valid(self, frontier: dict) -> bool:
+        """Host-only revalidation of a restored delta frontier against
+        the freshly rebuilt views: the shared prefix still covers
+        ``s``, the anchor is a live non-special lane, every divergent
+        lane is still inside the delta domain, and the window fits
+        the restored budget. O(divergence) numpy — never a device
+        fetch."""
+        w_cap = int(frontier["w_cap"])
+        for r, (va, vb) in enumerate(self._views):
+            sp = int(frontier["s"][r])
+            anchor = int(frontier["anchor"][r])
+            if sp < 1 or anchor >= sp:
+                return False
+            if lanecache.shared_prefix_len(va, vb) < sp:
+                return False
+            if int(va.arena.vclass[anchor]) > 0:
+                return False
+            if va.n - sp > w_cap - 1 or vb.n - sp > w_cap - 1:
+                return False
+            if not (delta_domain_ok(va, sp, anchor)
+                    and delta_domain_ok(vb, sp, anchor)):
+                return False
+        return True
+
+
+def _pack_arr(arr: np.ndarray) -> dict:
+    """A numpy array as a compact JSON-able dict (base64 of the raw
+    bytes + dtype + shape) — rank/visibility checkpoints at fleet
+    scale would be absurd as JSON number lists."""
+    import base64
+
+    arr = np.ascontiguousarray(arr)
+    return {
+        "dtype": str(arr.dtype),
+        "shape": list(arr.shape),
+        "b64": base64.b64encode(arr.tobytes()).decode("ascii"),
+    }
+
+
+def _unpack_arr(d: dict) -> np.ndarray:
+    import base64
+
+    raw = base64.b64decode(d["b64"])
+    arr = np.frombuffer(raw, dtype=np.dtype(d["dtype"]))
+    return arr.reshape([int(x) for x in d["shape"]]).copy()

@@ -58,12 +58,14 @@ import numpy as np
 
 import jax.numpy as jnp
 
+from .. import chaos as _chaos
 from .. import obs
 from ..collections import shared as s
 from ..weaver import lanecache
 from ..weaver.arrays import I32_MAX, next_pow2
 from ..weaver.segments import (SEG_LANE_KEYS, _TABLE_DTYPES,
                                concat_seg_tables, tree_segments)
+from . import recovery as _recovery
 from .wave import (WaveResult, _assemble_rows, delta_domain_ok,
                    dispatch_full_rows)
 
@@ -430,10 +432,12 @@ def _delta_level(pairs, state, level, uuid, byes, final):
             lanes = _assemble_level(sides_pairs, state, wcap)
         pdig = np.full(P, np.uint32(state["pdig"]), np.uint32)
         r0 = np.full(P, state["s"] - 1, np.int32)
-        rank_w, _vis_w, dig, ovf = jaxwd.batched_delta_weave(
-            *(jnp.asarray(lanes[k]) for k in LANE_KEYS5),
-            jnp.asarray(pdig), jnp.asarray(r0),
-            u_max=int(n_w), k_max=int(n_w))
+        rank_w, _vis_w, dig, ovf = _recovery.run_dispatch(
+            "tree",
+            lambda: jaxwd.batched_delta_weave(
+                *(jnp.asarray(lanes[k]) for k in LANE_KEYS5),
+                jnp.asarray(pdig), jnp.asarray(r0),
+                u_max=int(n_w), k_max=int(n_w)))
         if obs.enabled():
             from ..obs import costmodel as _cm
 
@@ -567,6 +571,13 @@ def _full_level(pairs, state, level, uuid, byes, bye_subs, final):
                 sub.side = _side_of(sub.views[0], sp, anchor)
         else:
             obs.counter("tree.establish_fail").inc()
+            if obs.enabled():
+                # the next level cannot ride delta: declared, not
+                # silent — the levels that follow run full width
+                # until an establishment succeeds
+                _recovery.step("tree", "delta", "full",
+                               "establish-fail", uuid=uuid,
+                               level=level)
     stats = {"level": level, "pairs": P, "byes": byes, "path": "full",
              "window": int(cap), "delta_ops": int(delta_ops),
              "distinct": len(set(int(d) for d in dig)),
@@ -631,7 +642,21 @@ def _merge_tree_impl(handles, w_budget: Optional[int]):
                     # state stays live: the symbolic survivors still
                     # materialize through it)
                     obs.counter("tree.window_bounce").inc()
+                    if obs.enabled():
+                        _recovery.step("tree", "delta", "full",
+                                       "window-budget", uuid=uuid,
+                                       level=level)
                     use_delta = False
+            if use_delta and _chaos.enabled() \
+                    and _chaos.budget_exhaust("tree"):
+                # injected window-budget exhaustion: identical ladder
+                # rung, identical (bit-identical) full-width bounce
+                obs.counter("tree.window_bounce").inc()
+                if obs.enabled():
+                    _recovery.step("tree", "delta", "full",
+                                   "budget-exhaustion", uuid=uuid,
+                                   level=level)
+                use_delta = False
             if use_delta:
                 out = _delta_level(pairs, state, level, uuid, byes,
                                    final)

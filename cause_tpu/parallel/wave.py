@@ -39,6 +39,7 @@ from ..collections import shared as s
 from ..weaver import lanecache
 from ..weaver.arrays import I32_MAX, next_pow2
 from ..weaver.segments import SEG_LANE_KEYS, concat_seg_tables
+from . import recovery as _recovery
 
 __all__ = ["merge_wave", "WaveResult", "WaveBuffers",
            "delta_domain_ok", "assemble_delta_window",
@@ -218,9 +219,11 @@ def dispatch_full_rows(lanes, site: str = "tree"):
     u_max = next_pow2(u_need)
 
     def _run(sub, u):
-        out = batched_weave_digest(
-            *(jnp.asarray(sub[k]) for k in LANE_KEYS5),
-            u_max=int(u), k_max=int(u))
+        out = _recovery.run_dispatch(
+            site,
+            lambda: batched_weave_digest(
+                *(jnp.asarray(sub[k]) for k in LANE_KEYS5),
+                u_max=int(u), k_max=int(u)))
         if obs.enabled():
             from ..obs import costmodel as _cm
 
@@ -233,6 +236,11 @@ def dispatch_full_rows(lanes, site: str = "tree"):
         rows = np.flatnonzero(overflow)
         retried = len(rows)
         obs.counter("wave.overflow_retry").inc(retried)
+        if obs.enabled():
+            # recovery-ladder rung: the sampled token budget missed a
+            # spiky row, escalate just those rows to a doubled budget
+            _recovery.step(site, "full", "double_budget",
+                           "token-overflow", rows=retried)
         sub = {k: lanes[k][rows] for k in LANE_KEYS5}
         r2, v2, d2, ov2 = _run(sub, 2 * u_max)
         if ov2.any():
@@ -550,10 +558,32 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
     for a, b in pairs:
         s.check_mergeable(a.ct, b.ct)
 
+    from .. import sync as _sync
+
+    quarantine_live = _sync.any_quarantined()
     views: List[Optional[Tuple[object, object]]] = []
     fallback = {}
     poisoned: dict = {}
     for i, (a, b) in enumerate(pairs):
+        if quarantine_live and (
+                _sync.is_quarantined(a.ct.site_id)
+                or _sync.is_quarantined(b.ct.site_id)):
+            # a quarantined replica is OUT of the device wave: its
+            # pair runs the host merge, whose full append-only body
+            # validation is exactly what a repeat payload offender
+            # has to pass — a corrupt one lands in poisoned below,
+            # never in the digest-only device path
+            obs.counter("wave.quarantined").inc()
+            if obs.enabled():
+                _recovery.step("wave", "full", "host", "quarantined",
+                               uuid=str(a.ct.uuid), pair=i)
+            try:
+                fallback[i] = a.merge(b)
+            except s.CausalError as err:
+                err.info["pair"] = i
+                poisoned[i] = err
+            views.append(None)
+            continue
         # view_for returns None for map trees (they need the mapw
         # forest encoding) and off-domain ids: both take the correct
         # per-pair host merge below
@@ -666,10 +696,12 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
                     *a, u_max=u_max, k_max=k_max,
                     euler="walk" if pipeline == "v5w" else "doubling")
 
-        r, v, _c, ov = _batched(
-            *(jnp.asarray(sub_lanes[k]) for k in LANE_KEYS5),
-            u_max=u, k_max=u,
-        )
+        r, v, _c, ov = _recovery.run_dispatch(
+            "wave",
+            lambda: _batched(
+                *(jnp.asarray(sub_lanes[k]) for k in LANE_KEYS5),
+                u_max=u, k_max=u,
+            ))
         d = _digest_fn()(jnp.asarray(sub_lanes["hi"]),
                          jnp.asarray(sub_lanes["lo"]), r, v)
         if obs.enabled():
@@ -737,6 +769,11 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
         obs.counter("wave.overflow_retry").inc(len(rows))
         obs.event("wave.overflow_retry", rows=len(rows),
                   u_max=int(u_max))
+        if obs.enabled():
+            _recovery.step("wave", "full", "double_budget",
+                           "token-overflow",
+                           uuid=str(pairs[0][0].ct.uuid),
+                           rows=n_retried)
         sub = {k: lanes[k][rows] for k in LANE_KEYS5}
         with obs.span("wave.dispatch.retry", rows=len(rows),
                       u_max=int(2 * u_max)):
@@ -758,6 +795,12 @@ def _merge_wave(pairs, mesh, ctx) -> WaveResult:
     for j, i in enumerate(live):
         if bool(overflow[j]):
             a, b = pairs[i]
+            if obs.enabled():
+                # the ladder's last rung: still overflowing at the
+                # doubled budget, this pair runs the host merge
+                _recovery.step("wave", "double_budget", "host",
+                               "token-overflow",
+                               uuid=str(a.ct.uuid), pair=i)
             try:
                 # budget blown: host path, correct
                 fallback[i] = a.merge(b)

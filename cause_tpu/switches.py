@@ -41,6 +41,7 @@ KNOWN_ENV_KNOBS = (
     "CAUSE_TPU_BODY_SAMPLE",
     "CAUSE_TPU_LEDGER",
     "CAUSE_TPU_LAG_SLO_MS",
+    "CAUSE_TPU_CHAOS",
 )
 
 # The XLA-only streaming candidate combination ("beststream"): the

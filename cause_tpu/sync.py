@@ -33,8 +33,10 @@ from __future__ import annotations
 import json
 import struct
 import threading
+import zlib
 from typing import Dict, Optional, Tuple
 
+from . import chaos as _chaos
 from . import obs
 from .collections import shared as s
 from . import serde
@@ -47,6 +49,14 @@ __all__ = [
     "delta_nodes",
     "shadow",
     "apply_delta",
+    "payload_checksum",
+    "validate_node_items",
+    "is_quarantined",
+    "any_quarantined",
+    "quarantined",
+    "note_reject",
+    "readmit",
+    "quarantine_reset",
     "send_frame",
     "recv_frame",
     "exchange_frame",
@@ -61,6 +71,10 @@ MAX_FRAME = 1 << 28  # 256 MB: fail loudly on a corrupt length prefix
 # declaring the peer wedged (generous: full-bag frames on slow uplinks
 # legitimately take minutes)
 SEND_DRAIN_TIMEOUT = 600.0
+# consecutive rejected payloads from one peer before it is quarantined
+# out of delta exchanges (and device waves) until a clean validated
+# full-bag resync re-admits it
+QUARANTINE_AFTER = 3
 
 
 def version_vector(handle) -> Dict[str, list]:
@@ -147,6 +161,192 @@ def apply_delta(handle, nodes: dict, _count_as_delta: bool = True):
         _lag.ops_applied(handle.ct.uuid, nodes.keys(),
                          replica=handle.ct.site_id)
     return merged
+
+
+# ---------------------------------------------- validate-before-apply
+#
+# PR 11: a sync payload crosses a trust boundary (a socket, a pipe, a
+# chaos-mangled loopback). Before this layer existed, a corrupted or
+# truncated payload either raised a bare TypeError deep inside the
+# weave (decode succeeded, the merge choked on a malformed id) or —
+# worse — merged cleanly and poisoned the document. Every ingest now
+# validates STRUCTURE (triple shape, id types, canonical sort order,
+# duplicate ids) and, on framed transports, a CRC32 checksum, and a
+# failing payload is REJECTED at the boundary with a ``sync.reject``
+# event: the document is untouched and the round degrades to the
+# full-bag resync it already knew how to run.
+
+
+def payload_checksum(encoded_items: list) -> int:
+    """CRC32 over the canonical JSON of an encoded node-items payload
+    (``serde.encode_node_items`` output) — the integrity tag delta and
+    full frames carry as ``crc``."""
+    blob = json.dumps(encoded_items, separators=(",", ":"),
+                      allow_nan=False).encode()
+    return zlib.crc32(blob) & 0xFFFFFFFF
+
+
+def _valid_id(enc) -> bool:
+    return (isinstance(enc, (list, tuple)) and len(enc) == 3
+            and isinstance(enc[0], int) and not isinstance(enc[0], bool)
+            and isinstance(enc[1], str) and enc[1] != ""
+            and isinstance(enc[2], int) and not isinstance(enc[2], bool)
+            and enc[0] >= 0 and enc[2] >= 0)
+
+
+def validate_node_items(data) -> None:
+    """Structural validation of an encoded node-items payload, raising
+    ``CausalError`` (causes ``{"payload-invalid"}``) on the first
+    violation. Checks per item: ``[id, cause, value]`` triple shape,
+    id = ``[ts >= 0, nonempty site str, tx >= 0]``, id-shaped causes
+    well-formed; payload-wide: ids strictly increasing (the canonical
+    ``encode_node_items`` sort — a reordered payload was tampered
+    with) and therefore unique (a duplicated id ditto)."""
+
+    def bad(why: str, index: Optional[int] = None):
+        info = {"causes": {"payload-invalid"}, "why": why}
+        if index is not None:
+            info["index"] = index
+        return s.CausalError("sync payload rejected", info)
+
+    if not isinstance(data, list):
+        raise bad("payload is not a list")
+    prev = None
+    for i, item in enumerate(data):
+        if not isinstance(item, (list, tuple)) or len(item) != 3:
+            raise bad("node triple malformed", i)
+        enc_id, enc_cause, _value = item
+        if not _valid_id(enc_id):
+            raise bad("node id malformed", i)
+        # a cause is an id (positional list) or a tagged value (map
+        # keys); a LIST-shaped cause must be id-shaped — anything else
+        # would decode into garbage the weave chokes on later
+        if isinstance(enc_cause, (list, tuple)) and not _valid_id(
+                enc_cause):
+            raise bad("cause id malformed", i)
+        key = (enc_id[0], enc_id[1], enc_id[2])
+        if prev is not None and key <= prev:
+            raise bad("ids out of canonical order (reordered or "
+                      "duplicated payload)", i)
+        prev = key
+
+
+def checked_decode(frame_nodes, crc: Optional[int] = None) -> dict:
+    """Validate-then-decode one payload: structure first, checksum (if
+    the frame carried one) second, ``serde.decode_node_items`` last.
+    Raises ``CausalError`` with ``payload-invalid`` / ``payload-
+    checksum`` causes instead of letting a poisoned payload reach the
+    merge."""
+    validate_node_items(frame_nodes)
+    if crc is not None and payload_checksum(frame_nodes) != crc:
+        raise s.CausalError(
+            "sync payload rejected",
+            {"causes": {"payload-checksum"},
+             "why": "checksum mismatch"},
+        )
+    try:
+        return serde.decode_node_items(frame_nodes)
+    except Exception:  # noqa: BLE001 - decode of validated shape
+        raise s.CausalError(
+            "sync payload rejected",
+            {"causes": {"payload-invalid"}, "why": "undecodable"},
+        ) from None
+
+
+def _is_payload_reject(e: s.CausalError) -> bool:
+    return bool({"payload-invalid", "payload-checksum"}
+                & set(e.info.get("causes", ())))
+
+
+# ------------------------------------------------ replica quarantine
+#
+# Repeat offenders: a peer whose payloads keep failing validation is
+# either corrupt or hostile; after QUARANTINE_AFTER consecutive
+# rejects it is quarantined — delta exchanges skip it (straight to
+# the validated full-bag resync) and merge_wave routes its pairs to
+# the fully-validating host merge instead of the device kernel. A
+# clean full-bag resync re-admits it (``sync.readmit``). The registry
+# is process-wide, keyed by the peer replica's site id.
+
+_Q_LOCK = threading.Lock()
+_REJECTS: Dict[str, int] = {}   # peer site id -> consecutive rejects
+_QUARANTINED: set = set()
+
+
+def note_reject(peer: str, uuid: str = "", why: str = "") -> int:
+    """Record one rejected payload from ``peer``; quarantines it at
+    QUARANTINE_AFTER consecutive rejects. Returns the consecutive
+    count. Emits ``sync.reject`` (and ``sync.quarantine`` on the
+    transition) when obs is on."""
+    peer = str(peer or "")
+    newly = False
+    if peer:
+        with _Q_LOCK:
+            n = _REJECTS.get(peer, 0) + 1
+            _REJECTS[peer] = n
+            if n >= QUARANTINE_AFTER and peer not in _QUARANTINED:
+                _QUARANTINED.add(peer)
+                newly = True
+    else:
+        n = 1
+    if obs.enabled():
+        _sem.sync_rejected(why or "payload-invalid", uuid=uuid,
+                           peer=peer)
+        if newly:
+            _sem.sync_quarantined(peer, uuid=uuid, rejects=n)
+    return n
+
+
+def _note_clean(peer: str) -> None:
+    """A validated payload from ``peer`` landed: the consecutive
+    -reject counter resets (quarantine itself only lifts via
+    :func:`readmit`)."""
+    peer = str(peer or "")
+    if not peer:
+        return
+    with _Q_LOCK:
+        _REJECTS.pop(peer, None)
+
+
+def readmit(peer: str, uuid: str = "") -> bool:
+    """Lift ``peer``'s quarantine after a clean validated full-bag
+    resync; returns whether it was quarantined. Emits
+    ``sync.readmit`` when obs is on. A full bag from a peer that is
+    NOT quarantined changes nothing — in particular it does not reset
+    the consecutive-reject count, or a repeat offender whose every
+    reject heals over a full bag could never cross the threshold."""
+    peer = str(peer or "")
+    with _Q_LOCK:
+        was = peer in _QUARANTINED
+        if was:
+            _QUARANTINED.discard(peer)
+            _REJECTS.pop(peer, None)
+    if was and obs.enabled():
+        _sem.sync_readmitted(peer, uuid=uuid)
+    return was
+
+
+def is_quarantined(peer) -> bool:
+    with _Q_LOCK:
+        return str(peer or "") in _QUARANTINED
+
+
+def any_quarantined() -> bool:
+    """Cheap wave-path guard: True iff any replica is quarantined
+    (merge_wave checks per-pair only past this)."""
+    return bool(_QUARANTINED)
+
+
+def quarantined() -> frozenset:
+    with _Q_LOCK:
+        return frozenset(_QUARANTINED)
+
+
+def quarantine_reset() -> None:
+    """Drop all quarantine/offender state (tests)."""
+    with _Q_LOCK:
+        _REJECTS.clear()
+        _QUARANTINED.clear()
 
 
 def send_frame(stream, obj: dict) -> None:
@@ -240,6 +440,9 @@ def sync_stream(handle, stream):
         obs.event("run.heartbeat", stage="sync.stream", uuid=ct.uuid)
     hello = exchange_frame(stream, {
         "op": "hello", "uuid": ct.uuid, "type": ct.type,
+        # sender identity for the offender/quarantine registry (an
+        # old peer without it just gets no quarantine bookkeeping)
+        "site": ct.site_id,
         "vv": version_vector(handle),
     })
 
@@ -260,16 +463,16 @@ def sync_stream(handle, stream):
                  "missing": key},
             ) from None
 
-    def decode_frame_nodes(frame, op):
-        try:
-            return serde.decode_node_items(frame_field(frame, op, "nodes"))
-        except s.CausalError:
-            raise
-        except Exception:  # noqa: BLE001 - corrupt triple shapes
-            raise s.CausalError(
-                "sync protocol error",
-                {"causes": {"bad-frame"}, "expected": op},
-            ) from None
+    def nodes_frame(op, nodes_map, mangle_site):
+        """An outbound node-carrying frame: canonical encoding, CRC
+        computed over the TRUE payload, then the chaos transport
+        mangle (after the CRC, exactly where a real link corrupts) —
+        so every injected payload fault is detectable."""
+        enc = serde.encode_node_items(nodes_map)
+        frame = {"op": op, "nodes": enc, "crc": payload_checksum(enc)}
+        if _chaos.enabled():
+            frame["nodes"] = _chaos.mangle_items(enc, mangle_site)
+        return frame
 
     if (frame_field(hello, "hello", "uuid") != ct.uuid
             or frame_field(hello, "hello", "type") != ct.type):
@@ -278,6 +481,8 @@ def sync_stream(handle, stream):
             {"causes": {"uuid-missmatch"},
              "uuids": [ct.uuid, hello.get("uuid")]},
         )
+    peer_site = hello.get("site")
+    peer_site = peer_site if isinstance(peer_site, str) else ""
     peer_vv = frame_field(hello, "hello", "vv")
     if not (isinstance(peer_vv, dict) and all(
             isinstance(site, str)
@@ -290,19 +495,44 @@ def sync_stream(handle, stream):
             {"causes": {"bad-frame"}, "expected": "hello",
              "missing": "vv"},
         )
-    delta = exchange_frame(stream, {
-        "op": "delta",
-        "nodes": serde.encode_node_items(delta_nodes(handle, peer_vv)),
-    })
+    delta = exchange_frame(
+        stream,
+        nodes_frame("delta", delta_nodes(handle, peer_vv),
+                    "sync.delta"),
+    )
     ok = True
-    try:
-        merged = apply_delta(handle, decode_frame_nodes(delta, "delta"))
-    except s.CausalError as e:
-        if "cause-must-exist" not in e.info.get("causes", ()):
-            raise
+    reason = None
+    if peer_site and is_quarantined(peer_site):
+        # quarantined peer: its deltas are not trusted — go straight
+        # to the validated full-bag resync, which is also its one
+        # road back in (readmission below)
         ok = False
+        reason = "quarantined"
         merged = handle
-    # prefix-gap fallback: ask for (and offer) the full bag
+    else:
+        try:
+            merged = apply_delta(
+                handle,
+                checked_decode(frame_field(delta, "delta", "nodes"),
+                               delta.get("crc")))
+            _note_clean(peer_site)
+        except s.CausalError as e:
+            if _is_payload_reject(e):
+                # the validate-before-apply boundary: the poisoned
+                # payload never reached the merge; the document is
+                # untouched and the round heals over the full bag
+                ok = False
+                reason = "payload-reject"
+                merged = handle
+                note_reject(peer_site, uuid=ct.uuid,
+                            why=next(iter(
+                                e.info.get("causes", ("payload",)))))
+            elif "cause-must-exist" in e.info.get("causes", ()):
+                ok = False
+                merged = handle
+            else:
+                raise
+    # prefix-gap / reject fallback: ask for (and offer) the full bag
     peer_state = exchange_frame(stream, {"op": "done" if ok else "resync"})
     if (not isinstance(peer_state, dict)
             or peer_state.get("op") not in ("done", "resync")):
@@ -313,14 +543,34 @@ def sync_stream(handle, stream):
     if peer_state.get("op") == "resync" or not ok:
         if obs.enabled():
             _sem.sync_full_bag(
-                "cause-must-exist" if not ok else "peer-resync",
+                reason or ("cause-must-exist" if not ok
+                           else "peer-resync"),
                 uuid=ct.uuid)
             _cm.note_full_bag(ct.uuid)
-        full = exchange_frame(stream, {
-            "op": "full", "nodes": serde.encode_node_items(dict(ct.nodes)),
-        })
-        merged = apply_delta(merged, decode_frame_nodes(full, "full"),
-                             _count_as_delta=False)
+        full = exchange_frame(
+            stream, nodes_frame("full", dict(ct.nodes), "sync.full"))
+        try:
+            merged = apply_delta(
+                merged,
+                checked_decode(frame_field(full, "full", "nodes"),
+                               full.get("crc")),
+                _count_as_delta=False)
+        except s.CausalError as e:
+            if _is_payload_reject(e):
+                # a poisoned FULL bag cannot heal this round: reject
+                # at the boundary (document untouched) and surface it
+                # — the next round retries the resync
+                note_reject(peer_site, uuid=ct.uuid,
+                            why=next(iter(
+                                e.info.get("causes", ("payload",)))))
+            raise
+        # a clean validated full bag re-admits a quarantined peer —
+        # but ONLY on the dedicated resync road (a round that STARTED
+        # quarantined): the full bag healing the very round whose
+        # rejects caused the quarantine must not instantly undo it,
+        # or quarantine would never outlive one protocol round
+        if peer_site and reason == "quarantined":
+            readmit(peer_site, uuid=ct.uuid)
     return merged
 
 
@@ -332,18 +582,51 @@ def sync_pair(a, b) -> Tuple[object, object]:
         obs.event("run.heartbeat", stage="sync.pair", uuid=a.ct.uuid)
     va, vb = version_vector(a), version_vector(b)
 
+    def full_bag(dst, src, reason):
+        if obs.enabled():
+            _sem.sync_full_bag(reason, uuid=dst.ct.uuid)
+            _cm.note_full_bag(dst.ct.uuid)
+        out = apply_delta(dst, dict(src.ct.nodes),
+                          _count_as_delta=False)
+        # the in-memory full bag comes straight off the live peer
+        # handle (already merge-validated state): it is the
+        # quarantine's validated exit ramp — but only on the
+        # dedicated resync road (reason "quarantined"), never the
+        # same-round heal of the reject that caused the quarantine
+        if reason == "quarantined":
+            readmit(src.ct.site_id, uuid=dst.ct.uuid)
+        return out
+
     def one_way(dst, src, dst_vv):
+        peer = src.ct.site_id
+        if is_quarantined(peer):
+            return full_bag(dst, src, "quarantined")
+        nodes = delta_nodes(src, dst_vv)
+        if _chaos.enabled() and nodes:
+            # the loopback's transport seam: round-trip the delta
+            # through the wire encoding so payload faults (and the
+            # validate-before-apply boundary) exercise exactly like a
+            # framed stream — chaos-off loopbacks never pay this
+            enc = serde.encode_node_items(nodes)
+            crc = payload_checksum(enc)
+            mangled = _chaos.mangle_items(enc, "sync.delta")
+            try:
+                nodes = checked_decode(mangled, crc)
+                _note_clean(peer)
+            except s.CausalError as e:
+                if not _is_payload_reject(e):
+                    raise
+                note_reject(peer, uuid=dst.ct.uuid,
+                            why=next(iter(
+                                e.info.get("causes", ("payload",)))))
+                return full_bag(dst, src, "payload-reject")
         try:
-            return apply_delta(dst, delta_nodes(src, dst_vv))
+            return apply_delta(dst, nodes)
         except s.CausalError as e:
             if "cause-must-exist" not in e.info.get("causes", ()):
                 raise
             # non-prefix history (weft, gapped replica): full bag
-            if obs.enabled():
-                _sem.sync_full_bag("cause-must-exist", uuid=dst.ct.uuid)
-                _cm.note_full_bag(dst.ct.uuid)
-            return apply_delta(dst, dict(src.ct.nodes),
-                               _count_as_delta=False)
+            return full_bag(dst, src, "cause-must-exist")
 
     return one_way(a, b, va), one_way(b, a, vb)
 

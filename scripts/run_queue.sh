@@ -1,5 +1,6 @@
 #!/bin/bash
-# SUPERSEDED (round 4): scripts/harvest.py + scripts/watcher_r4.sh run
+# SUPERSEDED (round 4): scripts/harvest.py + scripts/tunnel_watcher.sh
+# (harvest mode; the watcher_r4.sh shim is gone since PR 11) run
 # the whole ladder in one tunnel claim; this per-item queue is kept for
 # round-3 log provenance only. Known wart: `timeout --signal=CONT` is a
 # no-op bound (GNU timeout sends SIGCONT then keeps waiting), so the

@@ -5,6 +5,46 @@ every step. Runs until --minutes elapses; any failure prints the
 (seed, round, step) repro triple and exits 1.
 
 Usage: python scripts/soak.py [--minutes 60] [--seed0 0]
+
+Chaos mode (PR 11): ``python scripts/soak.py --chaos plan.json
+--obs-out chaos.jsonl`` runs a SEEDED FAULT SCHEDULE over an
+N-replica fleet instead of the timed soup — payload corruption on the
+sync mesh, dispatch failures / budget exhaustion / stalls on the wave
+session, crash-and-restart through the serde checkpoint — and gates:
+
+- **bit-identical convergence**: the faulted fleet's converged root
+  (device tree) must equal a fault-free pure-oracle fold replaying
+  the same ops with chaos suspended (nodes, weave and EDN equal),
+  and no document may carry the chaos corruption marker;
+- **every injected fault detected**: payload injects >= sync.reject
+  events, dispatch raises >= recovery.retry, budget exhausts >=
+  budget-exhaustion ladder steps, crashes >= recovery.restore, and
+  stalls measured in the wave wall;
+- **zero unrecovered faults / zero unquarantined divergence**: the
+  fleet report over the sidecar must show no divergence incidents
+  and an empty final quarantine set.
+
+A clean run lands a ``--kind chaos`` ledger row (value =
+mean-time-to-reconverge ms; extra = injected/detected counts and the
+recovery-path histogram). Exit 4 = convergence mismatch, exit 5 =
+undetected fault.
+
+Plan schema (JSON)::
+
+    {"seed": 11, "replicas": 8, "rounds": 6, "doc": 40,
+     "faults": [
+       {"family": "payload",  "site": "sync.delta",
+        "mode": "corrupt|truncate|duplicate|reorder|drop",
+        "at": [3], "prob": 0.0, "times": 0},
+       {"family": "dispatch", "site": "session",
+        "mode": "raise|exhaust", "at": [2]},
+       {"family": "crash",    "site": "session", "at": [3]},
+       {"family": "stall",    "site": "session", "ms": 150,
+        "at": [5]}]}
+
+``at`` indexes each spec's own per-site invocation counter (see
+``cause_tpu.chaos``); the same plan always injects the same faults at
+the same points.
 """
 
 from __future__ import annotations
@@ -12,6 +52,7 @@ from __future__ import annotations
 import _bootstrap  # noqa: F401
 
 import argparse
+import json
 import os
 import random
 import sys
@@ -420,6 +461,242 @@ def _lag_gate(args) -> int:
     return 0
 
 
+# ------------------------------------------------------- chaos mode
+
+
+def _chaos_fleet(n_replicas: int, doc: int):
+    """The chaos fleet: ``n_replicas`` distinct-site jax replicas of
+    one document (the sync mesh), a symmetric 4-pair FleetSession of
+    the same document (the wave/dispatch/crash surface), and pure
+    -weaver mirrors of both that replay the same ops with chaos
+    suspended — the fault-free oracle trajectory."""
+    from cause_tpu import chaos
+
+    base = CausalList(c_list.weave(
+        c.clist(weaver="jax").extend([f"w{i}" for i in range(doc)]).ct
+    ))
+    base.ct.lanes.segments()
+    sites = [new_site_id() for _ in range(n_replicas + 2)]
+    mesh = [CausalList(base.ct.evolve(site_id=s)) for s in
+            sites[:n_replicas]]
+    with chaos.suspended():
+        pure_base = base.ct.evolve(weaver="pure", lanes=None)
+        mesh_mirror = [CausalList(pure_base.evolve(site_id=s))
+                       for s in sites[:n_replicas]]
+        pa = CausalList(pure_base.evolve(site_id=sites[-2])).conj("A")
+        pb = CausalList(pure_base.evolve(site_id=sites[-1])).conj("B")
+    sa = CausalList(base.ct.evolve(site_id=sites[-2])).conj("A")
+    sb = CausalList(base.ct.evolve(site_id=sites[-1])).conj("B")
+    return mesh, mesh_mirror, sa, sb, pa, pb
+
+
+def _mttr_ms(events) -> float:
+    """Mean time from each ``chaos.inject`` to the next AGREED
+    ``wave.digest`` — the reconvergence latency of the faulted
+    fleet. Faults with no later agreed wave count against the last
+    record (they never reconverged; the convergence gate catches
+    that separately)."""
+    injects = []
+    agreed = []
+    last_ts = 0
+    for e in events:
+        ts = e.get("ts_us")
+        if not isinstance(ts, (int, float)):
+            continue
+        last_ts = max(last_ts, int(ts))
+        if e.get("ev") != "event":
+            continue
+        if e.get("name") == "chaos.inject":
+            injects.append(int(ts))
+        elif e.get("name") == "wave.digest" \
+                and (e.get("fields") or {}).get("agreed"):
+            agreed.append(int(ts))
+    if not injects:
+        return 0.0
+    lags = []
+    for t0 in injects:
+        nxt = next((t for t in agreed if t >= t0), last_ts)
+        lags.append(max(0, nxt - t0) / 1000.0)
+    return round(sum(lags) / len(lags), 3)
+
+
+def chaos_soak(args) -> int:
+    """The seeded fault-schedule soak (module docstring, "Chaos
+    mode"). Returns the process exit code."""
+    from cause_tpu import chaos, obs, sync
+    from cause_tpu.obs import ledger
+    from cause_tpu.obs.fleet import fleet_report
+    from cause_tpu.obs.perfetto import load_jsonl
+
+    with open(args.chaos) as f:
+        plan = json.load(f)
+    n_replicas = int(plan.get("replicas", 8))
+    rounds = int(plan.get("rounds", 6))
+    doc = int(plan.get("doc", 40))
+    sync.quarantine_reset()
+    mesh, mesh_mirror, sa, sb, pa, pb = _chaos_fleet(n_replicas, doc)
+    # warm the wave programs BEFORE arming chaos: compile spikes must
+    # not blur the stall/MTTR measurements, and warm-phase dispatches
+    # must not consume the plan's invocation counters
+    sess = FleetSession([(sa, sb)] * 4)
+    sess.wave()
+    chaos.configure(plan=plan)
+
+    stalled_waves = 0
+    crashes = 0
+    for r in range(rounds):
+        obs.event("run.heartbeat", stage="chaos-soak", round=r)
+        # --- sync mesh: seeded per-replica edits, two anti-entropy
+        # ring laps (payload faults fire inside sync_pair; rejects
+        # heal over the validated full-bag resync)
+        for i in range(n_replicas):
+            mesh[i] = mesh[i].conj(f"m{r}.{i}")
+        with chaos.suspended():
+            for i in range(n_replicas):
+                mesh_mirror[i] = mesh_mirror[i].conj(f"m{r}.{i}")
+        for _lap in range(2):
+            for i in range(n_replicas):
+                j = (i + 1) % n_replicas
+                mesh[i], mesh[j] = sync.sync_pair(mesh[i], mesh[j])
+        with chaos.suspended():
+            for _lap in range(2):
+                for i in range(n_replicas):
+                    j = (i + 1) % n_replicas
+                    mesh_mirror[i], mesh_mirror[j] = sync.sync_pair(
+                        mesh_mirror[i], mesh_mirror[j])
+        edns = {json.dumps(c.causal_to_edn(h), default=str)
+                for h in mesh}
+        if len(edns) != 1:
+            print(f"chaos soak: mesh diverged at round {r}",
+                  flush=True)
+            return 4
+        # --- wave session: symmetric edits, one wave (dispatch /
+        # stall / exhaust faults fire inside); crash faults drop the
+        # session and restore it from the serde checkpoint
+        sa, sb = sa.conj(f"x{r}"), sb.conj(f"y{r}")
+        with chaos.suspended():
+            pa, pb = pa.conj(f"x{r}"), pb.conj(f"y{r}")
+        sess.update([(sa, sb)] * 4)
+        log_before = len(chaos.injected())
+        t0 = time.perf_counter()
+        sess.wave()
+        wall_ms = (time.perf_counter() - t0) * 1000.0
+        # a stall is DETECTED only when a stall fault actually fired
+        # inside this wave AND the wall time shows the sleep — a
+        # naturally slow wave must not satisfy the detection gate
+        slept_ms = sum(f.get("stall_ms", 0.0)
+                       for f in chaos.injected()[log_before:]
+                       if f["family"] == "stall")
+        if slept_ms and wall_ms >= slept_ms:
+            stalled_waves += 1
+        if chaos.should_crash("session"):
+            ck = sess.checkpoint()
+            del sess  # the crash: ALL in-memory state is gone
+            sess = FleetSession.restore(ck)
+            crashes += 1
+    # --- convergence gates (chaos stays armed: a fault scheduled at
+    # the converge dispatch must be survivable too)
+    root = sess.converge()
+    with chaos.suspended():
+        oracle = pa.merge(pb)
+        oracle_pure = CausalList(oracle.ct.evolve(weaver="pure"))
+    ok = (c.causal_to_edn(root) == c.causal_to_edn(oracle_pure)
+          and dict(root.ct.nodes) == dict(oracle_pure.ct.nodes)
+          and [n[0] for n in root.get_weave()]
+          == [n[0] for n in oracle_pure.get_weave()])
+    mesh_ok = all(
+        c.causal_to_edn(mesh[i]) == c.causal_to_edn(mesh_mirror[i])
+        for i in range(n_replicas))
+    blob = json.dumps(
+        [c.causal_to_edn(root)] + [c.causal_to_edn(h) for h in mesh],
+        default=str)
+    clean = chaos.CORRUPT_MARKER not in blob
+    obs.flush()
+
+    rep = chaos.chaos_report()
+    counters = obs.counters_snapshot()["counters"]
+    evs = obs.events()
+    exhausts = sum(1 for e in evs if e.get("ev") == "event"
+                   and e.get("name") == "recovery.step"
+                   and (e.get("fields") or {}).get("reason")
+                   == "budget-exhaustion")
+    detected = {
+        "payload": counters.get("sync.reject", 0),
+        "dispatch_raise": counters.get("recovery.retry", 0),
+        "dispatch_exhaust": exhausts,
+        "crash": counters.get("recovery.restores", 0),
+        "stall": stalled_waves,
+    }
+    injected = dict(rep["by_family"])
+    n_raise = sum(1 for f in rep["log"]
+                  if f["family"] == "dispatch" and f["mode"] == "raise")
+    n_exh = sum(1 for f in rep["log"]
+                if f["family"] == "dispatch" and f["mode"] == "exhaust")
+    undetected = []
+    if detected["payload"] < injected.get("payload", 0):
+        undetected.append("payload")
+    if detected["dispatch_raise"] < n_raise:
+        undetected.append("dispatch/raise")
+    if detected["dispatch_exhaust"] < n_exh:
+        undetected.append("dispatch/exhaust")
+    if detected["crash"] < injected.get("crash", 0):
+        undetected.append("crash")
+    if detected["stall"] < injected.get("stall", 0):
+        undetected.append("stall")
+
+    flr = fleet_report(load_jsonl(args.obs_out))
+    quarantined_now = sorted(sync.quarantined())
+    mttr = _mttr_ms(evs)
+    summary = {
+        "injected": injected,
+        "injected_total": rep["injected"],
+        "detected": detected,
+        "recovery": flr["recovery"],
+        "divergence_incidents": len(flr["divergence_incidents"]),
+        "quarantined_final": quarantined_now,
+        "mttr_ms": mttr,
+        "converged_bit_identical": bool(ok and mesh_ok and clean),
+    }
+    obs.event("chaos.done", **summary)
+    obs.flush()
+    print("chaos soak:", json.dumps(summary, indent=1), flush=True)
+
+    if not (ok and mesh_ok and clean) \
+            or flr["divergence_incidents"] or quarantined_now:
+        print("chaos soak: CONVERGENCE GATE FAILED", flush=True)
+        return 4
+    if undetected:
+        print(f"chaos soak: UNDETECTED FAULT FAMILIES: {undetected}",
+              flush=True)
+        return 5
+    try:
+        row = ledger.ingest_record(
+            {
+                "platform": jax.default_backend(),
+                "metric": "chaos soak mean-time-to-reconverge",
+                "value": mttr,
+                "kernel": "chaos",
+                "config": f"replicas={n_replicas} rounds={rounds} "
+                          f"seed={plan.get('seed', 0)}",
+                "smoke": False,
+            },
+            source=f"chaos-soak plan={os.path.basename(args.chaos)}",
+            obs_jsonl=args.obs_out,
+            kind="chaos",
+            extra={"chaos": {k: v for k, v in summary.items()
+                             if k != "quarantined_final"}},
+        )
+        print(f"chaos soak: ledger row ({row['platform']}) -> "
+              f"{ledger.default_path()}", flush=True)
+    except Exception as e:  # noqa: BLE001 - best-effort ledger append
+        print(f"chaos soak: ledger append skipped "
+              f"({type(e).__name__}: {e})", flush=True)
+    print(f"chaos soak: {rep['injected']} fault(s) injected, all "
+          f"detected and recovered; fleet bit-identical to the "
+          f"fault-free oracle (MTTR {mttr:g} ms)", flush=True)
+    return 0
+
+
 def main():
     from cause_tpu import obs
     from cause_tpu.obs import lag
@@ -439,10 +716,21 @@ def main():
                          "records and exit 3 if attainment misses the "
                          "99%% goal (the soak as a lag-regression "
                          "gate); requires --obs-out")
+    ap.add_argument("--chaos", default="",
+                    help="run the seeded fault-schedule chaos soak "
+                         "from this plan JSON instead of the timed "
+                         "soup (see the module docstring); gates on "
+                         "bit-identical convergence vs the fault-free "
+                         "oracle and on every injected fault being "
+                         "detected; lands a --kind chaos ledger row; "
+                         "requires --obs-out")
     args = ap.parse_args()
     if args.slo_ms is not None and not args.obs_out:
         ap.error("--slo-ms requires --obs-out (the gate reads the "
                  "sidecar's lag.window records)")
+    if args.chaos and not args.obs_out:
+        ap.error("--chaos requires --obs-out (the committed obs "
+                 "stream IS the fault/recovery evidence)")
     if args.obs_out:
         obs.configure(enabled=True, out=args.obs_out)
         # honest platform tags on every record (obs never asks jax)
@@ -451,6 +739,14 @@ def main():
             # pin the recorded SLO target so every lag.window carries
             # the gate's own threshold, not the 100 ms default
             lag.set_slo(args.slo_ms)
+    if args.chaos:
+        rc = chaos_soak(args)
+        from cause_tpu import chaos as _chaos_mod
+
+        _chaos_mod.reset()
+        if rc:
+            sys.exit(rc)
+        return
     deadline = time.monotonic() + args.minutes * 60
     seed = args.seed0
     done = 0

@@ -2,8 +2,10 @@
 # The ONE tunnel watcher: the parameterized merge of the four
 # generations of near-identical retry loops that accreted per round
 # (queue_watcher.sh / queue_watcher2.sh / queue_watcher3.sh and
-# watcher_r4.sh / watcher_r5.sh — those names survive as one-line
-# delegators so every command documented in PERF.md keeps working).
+# watcher_r4.sh / watcher_r5.sh — the delegator shims that carried
+# those names were deleted in PR 11; PERF.md's historical commands
+# map to `tunnel_watcher.sh queue` / `tunnel_watcher.sh harvest
+# --round rN` parameterizations).
 #
 # Shared discipline, inherited from all generations:
 # - never kill a client (round-2 lesson: a killed axon client
@@ -174,9 +176,11 @@ harvest_mode() {
     exit 1
   fi
   # wait out any still-running measurement claimants (driver bench
-  # runs, an orphaned child from a replaced watcher, or a straggler
-  # pre-consolidation watcher whose argv still carries the old names)
-  while pgrep -f "run_queue.sh|queue_watcher|watcher_r4|scripts/harvest.py|scripts/api_bench.py|[ /]bench.py" \
+  # runs, an orphaned child from a replaced watcher). The pre-
+  # consolidation watcher names (queue_watcher*, watcher_r*) left
+  # this pattern in PR 11 with the delegators themselves: the lock
+  # above is the argv-independent exclusion.
+  while pgrep -f "run_queue.sh|scripts/harvest.py|scripts/api_bench.py|[ /]bench.py" \
       > /dev/null 2>&1; do
     [ "$(date +%s)" -ge "$deadline" ] && { note "deadline during claimant wait; exiting"; exit 1; }
     note "waiting for existing claimant processes to exit"

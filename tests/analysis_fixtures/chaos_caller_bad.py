@@ -1,0 +1,52 @@
+"""Known-bad CHS001 fixture: chaos/recovery APIs on a traced path.
+Only the unguarded calls gate — every OBS003-007 guard spelling
+(nested if, chaos.enabled, aliased import, early return, negated-test
+else) is sanctioned, and the ladder's own execution seam
+(recovery.run_dispatch) is sanctioned unguarded by design."""
+
+import jax
+
+from cause_tpu import chaos
+from cause_tpu import chaos as _chaos
+from cause_tpu import obs
+from cause_tpu.obs import enabled as _obs_enabled
+from cause_tpu.parallel import recovery
+from cause_tpu.parallel import recovery as _recovery
+
+
+@jax.jit
+def traced(x):
+    chaos.stall_point("wave")                      # CHS001: unguarded
+    recovery.step("wave", "delta", "full", "r")    # CHS001: unguarded
+    if chaos.enabled():
+        chaos.stall_point("wave")                  # guarded: fine
+    if _chaos.enabled():
+        # aliased module + the engine's own guard spelling
+        _chaos.budget_exhaust("wave")
+    if obs.enabled():
+        recovery.step("wave", "delta", "full", "r")  # guarded: fine
+    if _obs_enabled():
+        _recovery.restore_recorded("session", 4, True)
+    # the dispatch seam itself is sanctioned unguarded: it IS the
+    # execution path and self-guards its telemetry
+    return recovery.run_dispatch("wave", lambda: x * 2)
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with chaos off
+    if not chaos.enabled():
+        return x
+    chaos.dispatch_fault("wave")
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs unguarded
+    # (flagged), its ELSE branch is guarded (fine)
+    if not obs.enabled():
+        recovery.step("tree", "delta", "full", "r")  # CHS001
+    else:
+        recovery.step("tree", "delta", "full", "r")  # fine
+    return x

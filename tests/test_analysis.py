@@ -171,6 +171,23 @@ def test_live_unguarded_call_on_traced_path():
     assert rules_of(res) == ["OBS007"]
 
 
+def test_chaos_unguarded_call_on_traced_path():
+    """CHS001 (PR-11): chaos-engine hooks advance seeded RNG streams
+    under the engine lock and recovery telemetry assembles event
+    payloads when enabled — jit-reachable code must gate both behind
+    chaos.enabled()/obs.enabled(). Exactly three findings — two plain
+    unguarded calls and the body of a negated test; every OBS003-007
+    guard spelling is sanctioned, and the ladder's own execution seam
+    (recovery.run_dispatch) is sanctioned unguarded by design."""
+    res = run_api(os.path.join(FIX, "chaos_caller_bad.py"))
+    chs = [f for f in res.findings if f.rule == "CHS001"]
+    assert len(chs) == 3, [f.message for f in chs]
+    assert "stall_point" in chs[0].message
+    assert "recovery.step" in chs[1].message
+    assert "recovery.step" in chs[2].message
+    assert rules_of(res) == ["CHS001"]
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -285,7 +302,8 @@ def test_cli_exit_codes():
     "tid_bad.py", "jph_bad.py", os.path.join("obs", "obs_bad.py"),
     "obs_caller_bad.py", "devprof_caller_bad.py",
     "semantic_caller_bad.py", "costmodel_caller_bad.py",
-    "lag_caller_bad.py", "live_caller_bad.py", "lca_bad.py",
+    "lag_caller_bad.py", "live_caller_bad.py",
+    "chaos_caller_bad.py", "lca_bad.py",
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -296,7 +314,7 @@ def test_cli_list_rules():
     assert out.returncode == 0
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
-                "OBS006", "OBS007", "LCA001", "GEN001"):
+                "OBS006", "OBS007", "CHS001", "LCA001", "GEN001"):
         assert rid in out.stdout
 
 
