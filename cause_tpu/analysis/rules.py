@@ -838,6 +838,51 @@ def check_chs001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                     "it off the traced path)")
 
 
+# distinctive bare names for the sync-service layer (PR 12); generic
+# verbs (offer/drain/tick/evict) are matched through the ``serve``
+# module qualifier instead, or they would flag every unrelated queue.
+# The serve package is HOST work by design (admission, journaling,
+# LRU residency, lifecycle) — it takes queue locks, writes the WAL
+# and walks checkpoint packs; none of that belongs inside a traced
+# program even with obs off, so reaching it from jit-reachable code
+# unguarded is a structural smell, not just an overhead one.
+_SERVE_APIS = frozenset(
+    {"IngestQueue", "IngestJournal", "BatchController",
+     "ResidencyManager", "SyncService"}
+)
+
+
+@rule("SRV001",
+      "sync-service API reached from jit-reachable code without an "
+      "obs.enabled() guard (the serve layer takes admission-queue "
+      "locks, appends to the write-ahead journal and packs/restores "
+      "checkpoint-grade state — host lifecycle work that must never "
+      "sit on a traced path)")
+def check_srv001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module) or "serve" in module.segments:
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]):
+                # the sanctioned guard spellings, as in OBS003-007
+                continue
+            is_serve = (
+                parts[-1] in _SERVE_APIS
+                or any(p in ("serve", "_serve") for p in parts[:-1])
+            )
+            if is_serve and not guarded:
+                yield _finding(
+                    "SRV001", module, call,
+                    f"{'.'.join(parts)}() on a jit-reachable path "
+                    "without an obs.enabled() guard — the serve layer "
+                    "takes queue locks, journals admissions and "
+                    "spills/restores checkpoint packs; gate the call "
+                    "(or hoist it off the traced path)")
+
+
 # ----------------------------------------------------------------- LCA
 
 @rule("LCA001",

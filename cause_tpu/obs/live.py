@@ -96,7 +96,8 @@ class LiveFold:
 
     __slots__ = ("fleet", "cost", "first_ts_us", "last_ts_us",
                  "last_seen_us", "_wave_ts", "headroom_min",
-                 "headroom_last", "heartbeat")
+                 "headroom_last", "heartbeat", "serve_gauges",
+                 "_shed_ts", "shed_total", "serve_ticks")
 
     def __init__(self):
         self.fleet = FleetReducer()
@@ -112,6 +113,16 @@ class LiveFold:
         # the newest run.heartbeat fields (wedge triage: which ladder
         # item / wave stage was alive last)
         self.heartbeat: Optional[dict] = None
+        # PR 12, the sync service's live axes: last-seen serve gauges
+        # (queue_depth / resident_docs / t_batch_ms), shed-event
+        # timestamps (the shed_rate window), tick count. A stream with
+        # no serve.* records at all renders serve.active=False and the
+        # serve absence rule stays silent (a batch soak is not a dead
+        # service — it is not a service).
+        self.serve_gauges: Dict[str, float] = {}
+        self._shed_ts: deque = deque(maxlen=_RATE_TS_MAX)
+        self.shed_total = 0
+        self.serve_ticks = 0
 
     def feed(self, e: dict) -> None:
         self.fleet.feed(e)
@@ -137,15 +148,32 @@ class LiveFold:
                 if isinstance(ts, int):
                     hb["ts_us"] = ts
                 self.heartbeat = hb
-        elif ev == "gauge" and isinstance(name, str) \
-                and name.startswith("fleet.token_headroom."):
-            site = name[len("fleet.token_headroom."):]
-            v = e.get("value")
-            if isinstance(v, (int, float)):
-                self.headroom_last[site] = v
-                cur = self.headroom_min.get(site)
-                self.headroom_min[site] = (v if cur is None
-                                           else min(cur, v))
+            elif name == "serve.tick":
+                self.serve_ticks += 1
+                # every tick carries the controller's current window —
+                # read it here so a stable controller (no change, no
+                # gauge emission) still shows its T_batch on the
+                # dashboard
+                tb = (e.get("fields") or {}).get("t_batch_ms")
+                if isinstance(tb, (int, float)):
+                    self.serve_gauges["t_batch_ms"] = float(tb)
+            elif name == "serve.shed":
+                self.shed_total += 1
+                if isinstance(ts, int):
+                    self._shed_ts.append(ts)
+        elif ev == "gauge" and isinstance(name, str):
+            if name.startswith("fleet.token_headroom."):
+                site = name[len("fleet.token_headroom."):]
+                v = e.get("value")
+                if isinstance(v, (int, float)):
+                    self.headroom_last[site] = v
+                    cur = self.headroom_min.get(site)
+                    self.headroom_min[site] = (v if cur is None
+                                               else min(cur, v))
+            elif name.startswith("serve."):
+                v = e.get("value")
+                if isinstance(v, (int, float)):
+                    self.serve_gauges[name[len("serve."):]] = v
 
     def feed_many(self, events: Iterable[dict]) -> None:
         for e in events:
@@ -167,6 +195,16 @@ class LiveFold:
                     window_s: float = _RATE_WINDOW_S) -> float:
         cutoff = now_us - int(window_s * 1e6)
         n = sum(1 for t in self._wave_ts if t >= cutoff)
+        return round(n / window_s, 4)
+
+    def shed_rate(self, now_us: int,
+                  window_s: float = _RATE_WINDOW_S) -> float:
+        """``serve.shed`` events per second over the rate window —
+        the default ``shed_rate>0`` alert's axis: ANY shedding inside
+        the window is an excursion (overload is a declared policy,
+        and a declared policy firing is operator news)."""
+        cutoff = now_us - int(window_s * 1e6)
+        n = sum(1 for t in self._shed_ts if t >= cutoff)
         return round(n / window_s, 4)
 
     def ages_s(self, now_us: int) -> Dict[str, float]:
@@ -214,6 +252,19 @@ class LiveFold:
                 "last_by_site": dict(self.headroom_last),
             },
             "heartbeat": self.heartbeat,
+            "serve": {
+                "active": bool(self.serve_ticks or self.shed_total
+                               or self.serve_gauges
+                               or any(n.startswith("serve.")
+                                      for n in self.last_seen_us)),
+                "ticks": self.serve_ticks,
+                "queue_depth": self.serve_gauges.get("queue_depth"),
+                "resident_docs":
+                    self.serve_gauges.get("resident_docs"),
+                "t_batch_ms": self.serve_gauges.get("t_batch_ms"),
+                "shed_rate": self.shed_rate(now),
+                "sheds": self.shed_total,
+            },
             "ages_s": self.ages_s(now),
         }
         if self.cost.waves:
@@ -262,6 +313,12 @@ RULE_ALIASES = {
     "quarantined": "sync.quarantined",
     "recovery_per_wave": "recovery.per_wave",
     "recovery_retries": "recovery.retries",
+    # PR 12: the sync service's admission axes — bounded-queue depth,
+    # the shed-event rate over the sliding window, and the residency
+    # manager's device-resident tenant count
+    "queue_depth": "serve.queue_depth",
+    "shed_rate": "serve.shed_rate",
+    "resident_docs": "serve.resident_docs",
 }
 
 _OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -315,8 +372,15 @@ class Rule:
             if age is None and snap.get("records"):
                 # never seen: judge against the stream's own span —
                 # other records flowing while this event stays absent
-                # IS the wedge shape; a silent (empty) stream is not
-                age = snap.get("span_s")
+                # IS the wedge shape; a silent (empty) stream is not.
+                # Exception: serve.* events are judged only on streams
+                # that show serve activity — a batch soak that never
+                # ran a service is not a dead service, it is not a
+                # service at all (the default absence:serve.tick rule
+                # must not page on every long batch stream)
+                if not self.event.startswith("serve.") \
+                        or (snap.get("serve") or {}).get("active"):
+                    age = snap.get("span_s")
             if age is None or age <= self.window_s:
                 return None
             return {"age_s": age, "window_s": self.window_s,
@@ -387,7 +451,14 @@ def parse_rule(spec: str) -> Rule:
 # O(doc) degradations every round instead of riding the delta path
 DEFAULT_RULE_SPECS = ("burn>2", "absence:wave.digest:120",
                       "full_bag_rate>0.2", "quarantined>0",
-                      "recovery_per_wave>1")
+                      "recovery_per_wave>1",
+                      # PR 12, the sync-service pair: ANY shed inside
+                      # the rate window (the overload policy firing is
+                      # operator news), and a service whose tick
+                      # heartbeat goes absent for 60 s — the in-stream
+                      # twin of SyncService's own watchdog, inert on
+                      # streams with no serve activity (Rule._condition)
+                      "shed_rate>0", "absence:serve.tick:60")
 
 
 def default_rules() -> List[Rule]:
@@ -532,6 +603,17 @@ class LiveMonitor:
             "recovery_steps": snap["recovery"].get("steps", 0),
             "alerts_total": snap["alerts_total"],
         }
+        srv = snap.get("serve") or {}
+        if srv.get("active"):
+            # the service's dashboard row rides the same compact
+            # record; batch streams keep their PR-10 shape untouched
+            fields.update(
+                queue_depth=srv.get("queue_depth"),
+                shed_rate=srv.get("shed_rate"),
+                resident_docs=srv.get("resident_docs"),
+                t_batch_ms=srv.get("t_batch_ms"),
+                serve_ticks=srv.get("ticks"),
+            )
         if core.enabled():
             core.event("live.snapshot", **fields)
             with self._lock:

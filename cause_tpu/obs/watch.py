@@ -140,6 +140,15 @@ def render(snap: dict, alerts: List[dict], paths: List[str],
         per = ", ".join(f"{k} {_g(v)}" for k, v
                         in sorted(head.get("min_by_site", {}).items()))
         lines.append(f"  headroom: min {_g(head['min'])} ({per})")
+    srv = snap.get("serve") or {}
+    if srv.get("active"):
+        lines.append(
+            f"  serve: {srv.get('ticks', 0)} tick(s), "
+            f"queue depth {_g(srv.get('queue_depth'))}, "
+            f"{_g(srv.get('resident_docs'))} resident doc(s), "
+            f"T_batch {_g(srv.get('t_batch_ms'))} ms; "
+            f"{srv.get('sheds', 0)} shed(s) "
+            f"({_g(srv.get('shed_rate'))}/s)")
     hb = snap.get("heartbeat")
     if hb:
         hb_age = ages.get("run.heartbeat")
@@ -198,6 +207,12 @@ _PROM_METRICS = (
     ("cause_tpu_live_dispatches_total", "cost.dispatches", "counter"),
     ("cause_tpu_live_delta_ops_total", "cost.delta_ops", "counter"),
     ("cause_tpu_live_headroom_min", "headroom.min", "gauge"),
+    ("cause_tpu_live_serve_queue_depth", "serve.queue_depth", "gauge"),
+    ("cause_tpu_live_serve_resident_docs", "serve.resident_docs",
+     "gauge"),
+    ("cause_tpu_live_serve_shed_rate", "serve.shed_rate", "gauge"),
+    ("cause_tpu_live_serve_sheds_total", "serve.sheds", "counter"),
+    ("cause_tpu_live_serve_t_batch_ms", "serve.t_batch_ms", "gauge"),
     ("cause_tpu_live_alerts_total", "alerts_total", "counter"),
 )
 

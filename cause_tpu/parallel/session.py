@@ -839,8 +839,18 @@ class FleetSession:
         from .. import serde
 
         if isinstance(data, str):
-            with open(data) as f:
-                data = _json.load(f)
+            try:
+                with open(data) as f:
+                    data = _json.load(f)
+            except ValueError as e:
+                # a pack torn mid-spill (truncated JSON) refuses
+                # through the same declared gate as a tampered one —
+                # never a bare json error at the resume site
+                raise s.CausalError(
+                    "checkpoint file undecodable (torn pack?)",
+                    {"causes": {"checkpoint-mismatch"},
+                     "why": str(e)},
+                ) from None
         if not (isinstance(data, dict)
                 and data.get("~causal_session") == cls.CHECKPOINT_VERSION):
             raise s.CausalError(

@@ -1,0 +1,75 @@
+"""The resilient sync service — the long-lived serving loop, designed
+failure-first (ROADMAP item 4; SafarDB's offload split, arXiv:
+2603.08003: the accelerator owns merge, the host owns admission and
+ordering of replicated-data-type ops).
+
+Everything below this package is batch-mode machinery the previous
+rounds built and certified: delta-native waves (PR 7), the merge tree
+(PR 8), the lag SLO tracer (PR 9), the live feed (PR 10), and the
+fault substrate (PR 11: chaos engine, recovery ladder, checkpoint/
+restore). This package is the service those layers were built FOR —
+and its design question is explicitly the robustness one: what happens
+when the OFFERED LOAD, not the operator, decides what happens next?
+
+- :mod:`cause_tpu.serve.ingest` — bounded-queue admission: per-site
+  deltas validated at the boundary (``sync.validate_node_items`` —
+  poison never enters the queue; quarantine semantics preserved),
+  coalesced per tenant, journaled WRITE-AHEAD (admitted ops are never
+  lost), with a declared three-rung shed ladder (defer cold tenants →
+  reject-with-retry-after → drop oldest **unadmitted**) where every
+  shed is an evidenced ``serve.shed`` event;
+- :mod:`cause_tpu.serve.controller` — the adaptive T_batch controller:
+  the PERF.md Round-9 inversion
+  ``p99 ≈ T_batch + floor×dispatches + slope×batch_ops`` solved for
+  ``T_batch``, driven by the ``live.snapshot`` feedback term (sliding
+  SLO burn) and the ``fleet.token_headroom`` capacity term, clamped
+  and hysteresis-damped so alert flapping cannot oscillate the batch
+  size;
+- :mod:`cause_tpu.serve.residency` — lanecache LRU residency for hot
+  documents: cold tenants spill to host as checkpoint-grade packs
+  (PR 11's serde path) and a touch restores GATED on digest
+  bit-identity, so a zipf-hot tenant population larger than device
+  memory degrades to re-upload cost, never to wrong answers;
+- :mod:`cause_tpu.serve.service` — the lifecycle: ``serve.tick``
+  heartbeats with a watchdog, graceful drain (stop admission → flush
+  queue → converge → checkpoint), and restore-from-checkpoint that
+  replays the ingest journal above each tenant's applied watermark and
+  resumes steady-state delta waves.
+
+Import discipline: this ``__init__`` and the host-side modules
+(ingest, controller) are importable without jax — jax-touching pieces
+(sessions, residency restore) import lazily inside the functions that
+need them, the same rule the obs package follows. Acceptance
+instrument: ``scripts/serve_soak.py`` (open-loop zipf-hot/bursty load
+at multiples of the measured steady-state rate, with and without
+``--chaos``; ``--kind serve`` ledger rows).
+"""
+
+from .ingest import Admission, IngestJournal, IngestQueue
+from .controller import BatchController
+
+__all__ = [
+    "Admission",
+    "BatchController",
+    "IngestJournal",
+    "IngestQueue",
+    "ResidencyManager",
+    "ServiceCrashed",
+    "SyncService",
+]
+
+
+def __getattr__(name):
+    # ResidencyManager/SyncService pull in the jax-backed session
+    # machinery; resolve them lazily so `import cause_tpu.serve` stays
+    # jax-free for pure admission/controller users (CI lint job,
+    # pure-weaver processes)
+    if name in ("ResidencyManager",):
+        from .residency import ResidencyManager
+
+        return ResidencyManager
+    if name in ("SyncService", "ServiceCrashed"):
+        from . import service as _service
+
+        return getattr(_service, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -18,18 +18,26 @@
 #
 # Usage: bash scripts/tier1_chunks.sh [N_CHUNKS]
 #   N_CHUNKS             chunk count — positional arg, else the
-#                        TIER1_CHUNKS env var, else 4. More chunks =
+#                        TIER1_CHUNKS env var, else 7. More chunks =
 #                        shorter per-chunk wall time (each gets the
 #                        full TIER1_CHUNK_TIMEOUT) but more repeated
-#                        per-chunk jax import/compile overhead; 4-6
-#                        fits this container's ~1.5 cpu-shares.
+#                        per-chunk jax import/compile overhead.
 #   TIER1_CHUNK_TIMEOUT  per-chunk wall cap in seconds (default 870)
+#
+# Default vs CI: the default of 7 is the LOCAL-container number — PR 11
+# measured chunk 3-of-6 blowing the 870 s per-chunk cap on this
+# container's ~1.5 cpu-shares (6 was the previous honest minimum; the
+# chaos suite pushed it to 7). CI passes an explicit 4
+# (.github/workflows/ci.yml) because hosted runners have real cores
+# and fewer chunks amortize the repeated jax import/compile overhead
+# better there. If a chunk times out locally, raise N_CHUNKS before
+# raising the timeout.
 #
 # Exit: non-zero if any chunk failed tests or timed out; chunks keep
 # running after a failure so the merged dot total stays comparable.
 set -u -o pipefail
 
-N=${1:-${TIER1_CHUNKS:-4}}
+N=${1:-${TIER1_CHUNKS:-7}}
 PER_CHUNK_TIMEOUT=${TIER1_CHUNK_TIMEOUT:-870}
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,72 @@
+"""Known-bad SRV001 fixture: sync-service APIs on a traced path.
+Only the unguarded calls gate — every OBS003-007/CHS001 guard
+spelling (nested if, aliased import, early return, negated-test else)
+is sanctioned here too, and generic verbs (``q.offer``/``q.drain``)
+on non-serve objects must never be flagged."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu import serve
+from cause_tpu import serve as _serve
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    serve.IngestQueue(max_ops=64)                    # SRV001: unguarded
+    if obs.enabled():
+        q = serve.IngestQueue(max_ops=64)            # guarded: fine
+        q.offer("u", "s", [])
+    if _obs_enabled():
+        # the aliased module spelling is fine under the aliased guard
+        _serve.BatchController(slo_ms=100.0)
+    return x * 2
+
+
+@jax.jit
+def traced_bare_name(x):
+    # distinctive bare names gate without a module qualifier too
+    from cause_tpu.serve import SyncService
+
+    SyncService(None)                                # SRV001: unguarded
+    return x + 1
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    serve.ResidencyManager(capacity=8)
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful serve call), its ELSE branch is obs-on
+    # only (guarded: fine)
+    if not obs.enabled():
+        serve.IngestJournal("/tmp/j.jsonl")          # SRV001
+    else:
+        serve.IngestJournal("/tmp/j.jsonl")          # fine
+    return x
+
+
+class _NotServe:
+    def offer(self, *a):
+        return a
+
+    def drain(self):
+        return []
+
+
+@jax.jit
+def traced_generic_verbs_ok(x):
+    # offer()/drain() on an arbitrary object are NOT serve APIs — the
+    # rule matches the serve module qualifier or distinctive names only
+    q = _NotServe()
+    q.offer("u", "s", [])
+    q.drain()
+    return x

@@ -188,6 +188,24 @@ def test_chaos_unguarded_call_on_traced_path():
     assert rules_of(res) == ["CHS001"]
 
 
+def test_serve_unguarded_call_on_traced_path():
+    """SRV001 (PR-12): the sync-service layer takes admission-queue
+    locks, appends to the write-ahead journal and packs/restores
+    checkpoint-grade state — host lifecycle work that must never sit
+    on a traced path unguarded. Exactly three findings — the plain
+    unguarded call, a distinctive bare name, and the body of a
+    negated test; every OBS003-007/CHS001 guard spelling is
+    sanctioned, and generic verbs (offer/drain) on non-serve objects
+    never flag."""
+    res = run_api(os.path.join(FIX, "serve_caller_bad.py"))
+    srv = [f for f in res.findings if f.rule == "SRV001"]
+    assert len(srv) == 3, [f.message for f in srv]
+    assert "IngestQueue" in srv[0].message
+    assert "SyncService" in srv[1].message
+    assert "IngestJournal" in srv[2].message
+    assert rules_of(res) == ["SRV001"]
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -303,7 +321,7 @@ def test_cli_exit_codes():
     "obs_caller_bad.py", "devprof_caller_bad.py",
     "semantic_caller_bad.py", "costmodel_caller_bad.py",
     "lag_caller_bad.py", "live_caller_bad.py",
-    "chaos_caller_bad.py", "lca_bad.py",
+    "chaos_caller_bad.py", "serve_caller_bad.py", "lca_bad.py",
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -314,7 +332,8 @@ def test_cli_list_rules():
     assert out.returncode == 0
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
-                "OBS006", "OBS007", "CHS001", "LCA001", "GEN001"):
+                "OBS006", "OBS007", "CHS001", "SRV001", "LCA001",
+                "GEN001"):
         assert rid in out.stdout
 
 

@@ -620,6 +620,74 @@ def test_checkpoint_restore_to_file_and_gates(tmp_path):
         FleetSession.restore({"~causal_session": 999})
 
 
+def test_eviction_raced_with_checkpoint_restores_bit_identically(
+        tmp_path):
+    """PR 12: a document evicted to host MID-SESSION (lanecache LRU
+    residency under memory pressure) restores bit-identically — the
+    spill is a checkpoint-grade pack, the touch is a digest-gated
+    restore, and a service-level checkpoint taken while the tenant
+    sits spilled still round-trips the same digests."""
+    from cause_tpu.serve import ResidencyManager
+
+    base = _base(30)
+    rm = ResidencyManager(capacity=1, spill_dir=str(tmp_path / "sp"))
+    a, b = _pair(base)
+    hot = FleetSession([(a, b)] * 2)
+    hot.wave()
+    a, b = a.conj("h1"), b.conj("h2")
+    hot.update([(a, b)] * 2)
+    d_mid = hot.wave()  # mid-session state: waved after real edits
+    rm.insert("victim", hot)
+    a2, b2 = _pair(base, ("C",), ("D",))
+    other = FleetSession([(a2, b2)] * 2)
+    other.wave()
+    rm.insert("other", other)  # races "victim" out to disk
+    assert rm.spilled() == ["victim"]
+    # a drain-grade checkpoint_all taken WHILE the victim is spilled
+    out = rm.checkpoint_all(str(tmp_path / "ckpt"))
+    assert set(out) == {"victim", "other"}
+    from_pack = FleetSession.restore(
+        str(tmp_path / "ckpt" / "victim.ckpt.json"))
+    assert np.array_equal(from_pack._last_digest, d_mid)
+    # the touch restores through the digest gate, bit-identically,
+    # and resumes STEADY-STATE delta waves (the frontier rode the pack)
+    back = rm.get("victim")
+    assert np.array_equal(back._last_digest, d_mid)
+    a3, b3 = a.conj("x"), b.conj("y")
+    back.update([(a3, b3)] * 2)
+    d_next = back.wave()
+    control = FleetSession([(a3, b3)] * 2, delta=False)
+    assert np.array_equal(d_next, control.wave())
+
+
+def test_restore_refuses_pack_torn_during_spill(tmp_path):
+    """PR 12: a spill pack torn mid-write (truncated JSON) refuses
+    restore through the declared checkpoint-mismatch gate — never a
+    bare json error, never a silently wrong session."""
+    from cause_tpu.serve import ResidencyManager
+
+    base = _base()
+    rm = ResidencyManager(capacity=1, spill_dir=str(tmp_path / "sp"))
+    a, b = _pair(base)
+    s1 = FleetSession([(a, b)] * 2)
+    s1.wave()
+    rm.insert("t1", s1)
+    a2, b2 = _pair(base, ("C",), ("D",))
+    s2 = FleetSession([(a2, b2)] * 2)
+    s2.wave()
+    rm.insert("t2", s2)  # evicts t1 to disk
+    (path,) = [rm._spilled[u] for u in rm.spilled()]
+    blob = open(path).read()
+    with open(path, "w") as f:
+        f.write(blob[:len(blob) // 2])  # the torn spill
+    with pytest.raises(s.CausalError) as ei:
+        rm.get("t1")
+    assert "checkpoint-mismatch" in ei.value.info["causes"]
+    # the refusal cost a loud error, never a wrong answer: the other
+    # tenant is untouched and still serves
+    assert np.array_equal(rm.get("t2")._last_digest, s2._last_digest)
+
+
 def test_restore_emits_recovery_evidence():
     obs.configure(enabled=True)
     base = _base()

@@ -1,0 +1,769 @@
+"""PR 12: the resilient sync service.
+
+Pins the serving loop's four contracts:
+
+- **bounded admission** — poison never enters the queue (validate +
+  CRC at the boundary, quarantined sites refused outright), depth
+  never exceeds ``max_ops``, the shed ladder fires in its declared
+  order (defer cold tenants → reject-with-retry-after → drop oldest
+  unadmitted) and EVERY shed is an evidenced ``serve.shed`` event;
+- **no admitted op is ever lost** — admission is write-ahead (the
+  journal line lands before the ack), a crash at any point after
+  admission replays from the journal above each tenant's manifest
+  watermark, and replayed merges are idempotent;
+- **the T_batch controller is damped** — the Round-9 inversion gives
+  the target, burn/headroom move it, and clamp + hysteresis + step
+  cap + cooldown mean an alert flapping on a threshold cannot
+  oscillate the batch size;
+- **residency degrades to re-upload cost, never to wrong answers** —
+  LRU eviction spills checkpoint-grade packs, a touch restores gated
+  on digest bit-identity, and a torn or tampered pack refuses loudly.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import cause_tpu as c
+from cause_tpu import chaos, obs, serde, sync
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections import shared as s
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id
+from cause_tpu.serve import (Admission, BatchController, IngestJournal,
+                             IngestQueue, ResidencyManager,
+                             ServiceCrashed, SyncService)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    for k in ("CAUSE_TPU_CHAOS", "CAUSE_TPU_OBS", "CAUSE_TPU_OBS_OUT"):
+        monkeypatch.delenv(k, raising=False)
+    chaos.reset()
+    obs.reset()
+    sync.quarantine_reset()
+    yield
+    chaos.reset()
+    obs.reset()
+    sync.quarantine_reset()
+
+
+def _events(name=None):
+    evs = [e for e in obs.events() if e.get("ev") == "event"]
+    if name is None:
+        return evs
+    return [e for e in evs if e.get("name") == name]
+
+
+def _base(n=20):
+    base = CausalList(c_list.weave(
+        c.clist(weaver="jax").extend(["w"] * n).ct
+    ))
+    base.ct.lanes.segments()
+    return base
+
+
+def _pair(base, ea=("A",), eb=("B",)):
+    a = CausalList(base.ct.evolve(site_id=new_site_id()))
+    b = CausalList(base.ct.evolve(site_id=new_site_id()))
+    for v in ea:
+        a = a.conj(v)
+    for v in eb:
+        b = b.conj(v)
+    return a, b
+
+
+def _delta_items(new, old):
+    """The wire form one site offers: its appends since ``old``."""
+    return serde.encode_node_items(
+        sync.delta_nodes(new, sync.version_vector(old)))
+
+
+def _payload(n=3):
+    """A standalone valid payload of exactly ``n`` ops (a fresh
+    single-site list incl. its root node), for queue-only tests that
+    never touch a session."""
+    h = c.clist(*[f"v{i}" for i in range(n - 1)])
+    items = serde.encode_node_items(dict(h.ct.nodes))
+    assert len(items) == n
+    return items
+
+
+# ------------------------------------------------------------ ingest
+
+
+def test_admission_is_write_ahead_and_bounded(tmp_path):
+    jr = IngestJournal(str(tmp_path / "wal.jsonl"))
+    q = IngestQueue(max_ops=8, journal=jr)
+    items = _payload(3)
+    adm = q.offer("doc1", "siteA", items)
+    assert adm.admitted and adm.seq == 1
+    # write-ahead: the journal line is durable BEFORE any drain
+    lines = [json.loads(ln) for ln
+             in open(jr.path).read().splitlines()]
+    assert [e["seq"] for e in lines] == [1]
+    assert lines[0]["items"] == items
+    # bounded: a batch that would cross max_ops rejects with evidence
+    q.offer("doc1", "siteA", _payload(3))
+    big = q.offer("doc2", "siteB", _payload(4))
+    assert not big.admitted and big.rung == "reject"
+    assert big.reason == "capacity"
+    assert q.depth == 6 <= q.max_ops
+    assert q.stats["max_depth"] <= q.max_ops
+    assert q.stats["shed_by_rung"]["reject"] == 1
+    # the journal never saw the rejected batch
+    lines = open(jr.path).read().splitlines()
+    assert len(lines) == 2
+
+
+def test_poison_never_enters_queue_and_quarantine_refused():
+    obs.configure(enabled=True)
+    q = IngestQueue(max_ops=64)
+    bad = [[["not-an-id"], None, "x"]]
+    adm = q.offer("doc1", "siteP", bad)
+    assert not adm.admitted and adm.rung == "poison"
+    assert q.depth == 0 and q.stats["poison_rejects"] == 1
+    # the boundary reject rode the PR-11 offender machinery
+    assert _events("sync.reject")
+    # a CRC mismatch is poison too
+    good = _payload(2)
+    adm = q.offer("doc1", "siteP", good,
+                  crc=sync.payload_checksum(good) ^ 1)
+    assert not adm.admitted and adm.rung == "poison"
+    assert adm.reason == "payload-checksum"
+    # third strike quarantines; a quarantined site is refused outright
+    q.offer("doc1", "siteP", bad)
+    assert sync.is_quarantined("siteP")
+    adm = q.offer("doc1", "siteP", good,
+                  crc=sync.payload_checksum(good))
+    assert not adm.admitted and adm.rung == "quarantined"
+    assert q.stats["quarantine_refusals"] == 1
+    assert q.depth == 0
+
+
+def test_shed_ladder_defer_promote_and_drop_oldest():
+    obs.configure(enabled=True)
+    # watermark at 6 ops (0.75 * 8); defer buffer of 2
+    q = IngestQueue(max_ops=8, defer_frac=0.75, defer_max=2)
+    # make "hot" HOT (most of the admitted rate), then congest
+    q.offer("hot", "s1", _payload(3))
+    q.offer("hot", "s1", _payload(3))
+    assert q.depth == 6
+    # rung 1: a cold tenant over the watermark defers, unadmitted
+    d1 = q.offer("cold1", "s2", _payload(1))
+    assert not d1.admitted and d1.rung == "defer"
+    assert d1.reason == "cold-tenant" and q.deferred == 1
+    d2 = q.offer("cold2", "s3", _payload(1))
+    assert d2.rung == "defer" and q.deferred == 2
+    # rung 3: the defer buffer overflowing drops its OLDEST entry
+    d3 = q.offer("cold3", "s4", _payload(1))
+    assert d3.rung == "defer" and q.deferred == 2
+    rungs = [e["fields"]["rung"] for e in _events("serve.shed")]
+    assert rungs == ["defer", "defer", "drop_oldest", "defer"]
+    dropped = [e["fields"] for e in _events("serve.shed")
+               if e["fields"]["rung"] == "drop_oldest"]
+    assert dropped[0]["uuid"] == "cold1"  # oldest unadmitted
+    # every shed evidenced: stats and events agree exactly
+    assert q.stats["sheds"] == len(_events("serve.shed")) == 4
+    # drain below the watermark promotes the survivors FIFO
+    out = q.drain()
+    assert sum(e.ops for e in out) == 6
+    assert q.stats["deferred_promoted"] == 2
+    assert q.deferred == 0 and q.depth == 2
+    promoted = [e.uuid for e in q.drain()]
+    assert promoted == ["cold2", "cold3"]
+
+
+def test_deadline_aware_admission_sheds_at_the_door():
+    # low watermark: the deadline estimator only sees a backlog past
+    # the defer watermark (below it the queue "drains immediately")
+    q = IngestQueue(max_ops=1024, defer_frac=0.05, deadline_ms=5.0)
+    q.offer("u", "s", _payload(4))
+    # prime the drain-rate EMA: 4 ops over a forced 1 s span
+    t0 = q._q[0].ts_us
+    q.drain(now_us=t0 + 1_000_000)
+    assert q._drain_ops_per_s > 0
+    # build a backlog past the watermark at ~4 ops/s: the estimated
+    # wait crosses 5 ms long before capacity does
+    sheds = []
+    for _ in range(50):
+        adm = q.offer("u", "s", _payload(4), now_us=t0 + 1_000_000)
+        if not adm.admitted:
+            sheds.append(adm)
+    assert sheds, "deadline admission never fired"
+    assert all(a.rung == "reject" and a.reason == "deadline"
+               for a in sheds)
+    assert sheds[0].retry_after_ms is not None \
+        and sheds[0].retry_after_ms > 5.0
+    # depth stayed well under capacity: the door shed, not the wall
+    assert q.depth < q.max_ops
+
+
+def test_journal_replay_watermark_and_torn_lines(tmp_path):
+    path = str(tmp_path / "wal.jsonl")
+    jr = IngestJournal(path)
+    for i in range(3):
+        jr.append("u", "s", _payload(1))
+    jr.close()
+    # torn trailing line (crash mid-append) + garbage line
+    with open(path, "a") as f:
+        f.write('{"seq": 4, "uuid": "u"')  # torn
+        f.write("\nnot json\n")
+    jr2 = IngestJournal(path)
+    assert [e["seq"] for e in jr2.iter_from(1)] == [2, 3]
+    assert jr2.skipped >= 2
+    # the resumed counter continues past the intact entries
+    assert jr2.append("u", "s", _payload(1)) == 4
+
+
+def test_offer_thread_safety_under_concurrent_producers():
+    q = IngestQueue(max_ops=10_000)
+    payload = _payload(2)
+    errs = []
+
+    def producer(uuid):
+        try:
+            for _ in range(50):
+                q.offer(uuid, f"site-{uuid}", payload)
+        except Exception as e:  # noqa: BLE001 - collected for assert
+            errs.append(e)
+
+    threads = [threading.Thread(target=producer, args=(f"u{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert q.stats["admitted_batches"] == 200
+    assert q.depth == 400
+    drained = q.drain()
+    assert sum(e.ops for e in drained) == 400
+
+
+def test_defer_is_congestion_not_size_and_supersedes(tmp_path):
+    """Deferral is a CONGESTION response, never a size response: an
+    oversized cold batch on a quiet queue admits (the old depth+ops
+    gate starved it forever). And a site's offers are cumulative, so
+    a re-offer supersedes its own parked entry — replaced, never
+    promoted later as a journal-duplicating subset."""
+    jr = IngestJournal(str(tmp_path / "wal.jsonl"))
+    q = IngestQueue(max_ops=16, defer_frac=0.375, defer_max=4,
+                    journal=jr)  # watermark 6, hard bound 16
+    big = q.offer("cold", "s1", _payload(7))  # ops > watermark (6)
+    assert big.admitted
+    q.drain()
+    q.offer("hot", "s2", _payload(3))
+    q.offer("hot", "s2", _payload(3))
+    d = q.offer("cold2", "s3", _payload(2))
+    assert d.rung == "defer" and q.deferred == 1
+    d2 = q.offer("cold2", "s3", _payload(3))  # cumulative re-offer
+    assert d2.rung == "defer" and q.deferred == 1  # replaced
+    q.drain()  # depth under the watermark -> promote the survivor
+    assert q.deferred == 0
+    out = q.drain()
+    assert [e.uuid for e in out] == ["cold2"] and out[0].ops == 3
+    # the journal carries the tenant's admitted batch exactly once
+    assert sum(1 for e in jr.iter_from(0)
+               if e["uuid"] == "cold2") == 1
+
+
+def test_unknown_tenant_refused_at_the_door(tmp_path):
+    """An offer for a uuid nobody serves is refused unadmitted and
+    UNJOURNALED — admitting it would acknowledge an op no tenant can
+    ever apply (and a crash replay would trip over it)."""
+    jr = IngestJournal(str(tmp_path / "wal.jsonl"))
+    q = IngestQueue(max_ops=64, journal=jr,
+                    tenant_known=lambda u: u == "known")
+    items = _payload()
+    adm = q.offer("ghost", "siteA_________", items)
+    assert not adm.admitted and adm.reason == "unknown-tenant"
+    assert q.stats["unknown_tenant_rejects"] == 1
+    assert list(jr.iter_from(0)) == []  # write-ahead never happened
+    assert q.offer("known", "siteA_________", items).admitted
+    # SyncService wires its own registry into an unwired queue
+    q2 = IngestQueue(max_ops=64)
+    svc = SyncService(q2, d_max=16)
+    assert q2.tenant_known is not None
+    bad = q2.offer("nobody", "siteA_________", items)
+    assert not bad.admitted and bad.reason == "unknown-tenant"
+    svc.close()
+
+
+def test_hotness_registry_is_bounded():
+    from cause_tpu.serve import ingest as _ingest
+
+    q = IngestQueue(max_ops=1 << 30)
+    for i in range(_ingest._HOT_MAX + 64):
+        q._touch_hot(f"t{i}", 1, i)
+    assert len(q._hot) == _ingest._HOT_MAX
+    # the survivors are the most recently touched (LRU eviction)
+    assert f"t{_ingest._HOT_MAX + 63}" in q._hot
+    assert "t0" not in q._hot
+
+
+# -------------------------------------------------------- controller
+
+
+def _snap(burn=None, headroom=None, waves=10, dispatches=20,
+          delta_ops=100, slope=0.01):
+    return {
+        "lag": {"slo": {"burn_rate": burn}},
+        "headroom": {"min": headroom},
+        "cost": {"waves": waves, "dispatches": dispatches,
+                 "delta_ops": delta_ops,
+                 "slope": {"slope_ms_per_op": slope}},
+    }
+
+
+def test_controller_inversion_target():
+    ctrl = BatchController(slo_ms=100.0, floor_ms=10.0,
+                           t_min_ms=5.0, t_max_ms=2000.0)
+    # T = 100 - 10*(20/10) - 0.01*(100/10) = 100 - 20 - 0.1 = 79.9
+    assert ctrl.target_ms(_snap()) == pytest.approx(79.9)
+    # clamped low: a floor bigger than the SLO pins to t_min
+    ctrl2 = BatchController(slo_ms=100.0, floor_ms=200.0, t_min_ms=5.0)
+    assert ctrl2.target_ms(_snap()) == 5.0
+    # no cost data: the full SLO budget, clamped to t_max
+    ctrl3 = BatchController(slo_ms=5000.0, floor_ms=10.0,
+                            t_max_ms=2000.0)
+    assert ctrl3.target_ms({"cost": {}}) == 2000.0
+
+
+def test_controller_burn_shrinks_and_relax_recovers():
+    ctrl = BatchController(slo_ms=100.0, floor_ms=1.0, initial_ms=80.0,
+                           hysteresis=0.1, cooldown_ticks=0)
+    t1 = ctrl.update(_snap(burn=3.0))
+    assert t1 == 40.0 and ctrl.last_terms["why"] == "burn"
+    t2 = ctrl.update(_snap(burn=3.0))
+    assert t2 == 20.0
+    # comfortable burn relaxes back toward (never past) the target
+    for _ in range(30):
+        t = ctrl.update(_snap(burn=0.2))
+    assert t <= ctrl.target_ms(_snap(burn=0.2))
+    assert t == pytest.approx(ctrl.target_ms(_snap(burn=0.2)), rel=0.3)
+
+
+def test_controller_headroom_capacity_term():
+    ctrl = BatchController(slo_ms=100.0, floor_ms=1.0, initial_ms=80.0,
+                           hysteresis=0.1, cooldown_ticks=0)
+    # thin headroom (< 2x batch ops) halves T_batch whatever the SLO
+    t = ctrl.update(_snap(burn=0.1, headroom=3.0, delta_ops=100))
+    assert t == 40.0 and ctrl.last_terms["why"] == "headroom"
+
+
+def test_controller_alert_flapping_cannot_oscillate():
+    """The acceptance pin: an edge-triggered alert flapping every
+    tick moves T_batch at most once per cooldown window, stays inside
+    the clamp, and never exceeds the 2x/0.5x per-change step cap."""
+    ctrl = BatchController(slo_ms=100.0, floor_ms=1.0, initial_ms=50.0,
+                           t_min_ms=5.0, t_max_ms=200.0,
+                           hysteresis=0.2, cooldown_ticks=2)
+    seen = [ctrl.t_batch_ms]
+    for i in range(30):
+        if i % 2 == 0:
+            ctrl.on_alert({"rule": "burn>2", "value": 9.9})
+            snap = _snap(burn=9.9)
+        else:
+            snap = _snap(burn=0.1)
+        seen.append(ctrl.update(snap))
+    # rate limit: with a 2-tick cooldown, ≤ 1 change per 3 ticks
+    assert ctrl.changes <= 11
+    for prev, cur in zip(seen, seen[1:]):
+        assert 5.0 <= cur <= 200.0
+        assert cur <= prev * 2.0 + 1e-9 and cur >= prev / 2.0 - 1e-9
+    # hysteresis: a sub-threshold nudge is ignored entirely
+    ctrl2 = BatchController(initial_ms=50.0, floor_ms=1.0,
+                            hysteresis=0.5, cooldown_ticks=0)
+    before = ctrl2.t_batch_ms
+    ctrl2.update(_snap(burn=0.9))
+    assert ctrl2.t_batch_ms == before and ctrl2.changes == 0
+
+
+def test_controller_ignores_foreign_alerts():
+    ctrl = BatchController(initial_ms=50.0, floor_ms=1.0,
+                           cooldown_ticks=0)
+    ctrl.on_alert({"rule": "full_bag_rate>0.2"})
+    ctrl.update(_snap(burn=1.5))  # between LOW and HIGH: hold
+    assert ctrl.t_batch_ms == 50.0
+
+
+def test_controller_alert_during_cooldown_survives():
+    """An edge-triggered alert landing INSIDE the cooldown window is
+    not consumed by the gated tick — the alert fires once per
+    excursion, so it must still force the shrink on the first
+    post-cooldown update even if the sliding burn settled."""
+    ctrl = BatchController(slo_ms=100.0, floor_ms=1.0, initial_ms=80.0,
+                           hysteresis=0.1, cooldown_ticks=2)
+    assert ctrl.update(_snap(burn=3.0)) == 40.0  # change; cooldown arms
+    ctrl.on_alert({"rule": "burn>2", "value": 9.9})
+    assert ctrl.update(_snap(burn=1.5)) == 40.0  # cooldown tick
+    assert ctrl.update(_snap(burn=1.5)) == 40.0  # cooldown tick
+    t = ctrl.update(_snap(burn=1.5))  # flag survived -> shrink now
+    assert t == 20.0 and ctrl.last_terms["why"] == "burn"
+    # and it was consumed by that shrink: steady holds afterwards
+    ctrl._cooldown = 0
+    assert ctrl.update(_snap(burn=1.5)) == 20.0
+    ctrl.on_alert({"rule": "shed_rate>0"})
+    ctrl.update(_snap(burn=1.5))
+    assert ctrl.t_batch_ms == 10.0  # shed alert IS pressure (0.5x)
+
+
+# --------------------------------------------------------- residency
+
+
+def test_residency_lru_evicts_and_restores_bit_identically(tmp_path):
+    from cause_tpu.parallel.session import FleetSession
+
+    obs.configure(enabled=True)
+    base = _base()
+    rm = ResidencyManager(capacity=2, spill_dir=str(tmp_path / "sp"))
+    digests = {}
+    for i in range(3):
+        a, b = _pair(base, (f"A{i}",), (f"B{i}",))
+        sess = FleetSession([(a, b)], d_max=16)
+        sess.wave()
+        uuid = str(a.ct.uuid)
+        rm.insert(uuid if i == 0 else f"{uuid}-{i}", sess)
+        digests[uuid if i == 0 else f"{uuid}-{i}"] = np.asarray(
+            sess._last_digest).copy()
+    # capacity 2: the first-inserted tenant spilled to host
+    assert rm.resident_docs == 2 and len(rm.spilled()) == 1
+    (cold,) = rm.spilled()
+    assert rm.stats["evictions"] == 1
+    assert _events("serve.evict")
+    # touch restores through the digest gate, bit-identically
+    sess = rm.get(cold)
+    assert np.array_equal(np.asarray(sess._last_digest), digests[cold])
+    assert rm.stats["restores"] == 1 and _events("serve.restore")
+    # and the restore evicted someone else to make room BEFORE
+    # uploading (capacity holds at every instant — the eviction event
+    # precedes the restore event, never the other way around)
+    assert rm.resident_docs == 2 and len(rm.spilled()) == 1
+    ev_ts = [e["ts_us"] for e in _events("serve.evict")]
+    rs_ts = [e["ts_us"] for e in _events("serve.restore")]
+    assert max(ev_ts) <= min(rs_ts)
+    # unknown tenants are None, not an error
+    assert rm.get("never-seen") is None
+
+
+def test_residency_refuses_tampered_spill_pack(tmp_path):
+    from cause_tpu.parallel.session import FleetSession, _pack_arr, \
+        _unpack_arr
+
+    base = _base()
+    rm = ResidencyManager(capacity=1, spill_dir=str(tmp_path / "sp"))
+    a, b = _pair(base)
+    s1 = FleetSession([(a, b)], d_max=16)
+    s1.wave()
+    rm.insert("t1", s1)
+    a2, b2 = _pair(base, ("C",), ("D",))
+    s2 = FleetSession([(a2, b2)], d_max=16)
+    s2.wave()
+    rm.insert("t2", s2)  # evicts t1 to disk
+    (path,) = [p for p in rm._spilled.values()]
+    ck = json.load(open(path))
+    ck["digest"] = _pack_arr(_unpack_arr(ck["digest"]) + 1)
+    json.dump(ck, open(path, "w"))
+    with pytest.raises(s.CausalError) as ei:
+        rm.get("t1")
+    assert "checkpoint-mismatch" in ei.value.info["causes"]
+
+
+def test_residency_evict_requires_wave_current():
+    from cause_tpu.parallel.session import FleetSession
+
+    base = _base()
+    rm = ResidencyManager(capacity=4)
+    a, b = _pair(base)
+    sess = FleetSession([(a, b)], d_max=16)
+    sess.wave()
+    sess.update([(a.conj("x"), b)])  # updated past the last wave
+    rm.insert("t", sess)
+    with pytest.raises(s.CausalError) as ei:
+        rm.evict("t")
+    assert "no-wave" in ei.value.info["causes"]
+    # the refusal is loud AND lossless: the tenant stays resident
+    # (neither dropped nor spilled) and a wave makes it evictable
+    assert rm.get("t") is sess
+    assert rm.spilled() == []
+    sess.wave()
+    rm.evict("t")
+    assert rm.spilled() == ["t"]
+
+
+# ----------------------------------------------------------- service
+
+
+def _service(tmp_path, capacity=4, **kw):
+    jr = IngestJournal(str(tmp_path / "wal.jsonl"))
+    q = IngestQueue(max_ops=4096, journal=jr)
+    return SyncService(
+        q, residency=ResidencyManager(capacity=capacity),
+        checkpoint_dir=str(tmp_path / "ckpt"), d_max=16, **kw)
+
+
+def test_service_tick_applies_and_matches_pure_oracle(tmp_path):
+    svc = _service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    # two waves of per-site deltas, offered through the front door
+    left, right = svc.residency.get(uuid).pairs[0]
+    l2, r2 = left.conj("x1").conj("x2"), right.conj("y1")
+    svc.queue.offer(uuid, l2.ct.site_id, _delta_items(l2, left))
+    svc.queue.offer(uuid, r2.ct.site_id, _delta_items(r2, right))
+    out = svc.tick()
+    assert out["ops"] == 3 and out["tenants"] == 1
+    assert svc.queue.depth == 0
+    oracle = CausalList(l2.ct.evolve(weaver="pure", lanes=None)).merge(
+        CausalList(r2.ct.evolve(weaver="pure", lanes=None)))
+    assert c.causal_to_edn(svc.materialize(uuid)) \
+        == c.causal_to_edn(oracle)
+
+
+def test_service_drain_restore_bit_identical(tmp_path):
+    svc = _service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    left, right = svc.residency.get(uuid).pairs[0]
+    l2 = left.conj("x1")
+    svc.queue.offer(uuid, l2.ct.site_id, _delta_items(l2, left))
+    svc.tick()
+    manifest = svc.drain()
+    assert svc.queue.closed
+    d0 = svc.converged_digest(uuid)
+    edn0 = c.causal_to_edn(svc.materialize(uuid))
+    svc2 = SyncService.restore(os.path.dirname(manifest))
+    assert svc2.converged_digest(uuid) == d0
+    assert c.causal_to_edn(svc2.materialize(uuid)) == edn0
+    # the restored service resumes steady-state ticks
+    left2, right2 = svc2.residency.get(uuid).pairs[0]
+    l3 = left2.conj("x2")
+    adm = svc2.queue.offer(uuid, l3.ct.site_id,
+                           _delta_items(l3, left2))
+    assert adm.admitted
+    assert svc2.tick()["ops"] == 1
+
+
+def test_crash_after_admission_loses_zero_admitted_ops(tmp_path):
+    """THE robustness pin: ops admitted (journaled) but neither
+    drained nor checkpointed survive a crash — restore replays the
+    journal above the manifest watermark and converges bit-identical
+    to an oracle that saw every admitted op."""
+    obs.configure(enabled=True)
+    svc = _service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    svc.checkpoint()  # the last durable state before the crash
+    left, right = svc.residency.get(uuid).pairs[0]
+    l2, r2 = left.conj("x1"), right.conj("y1").conj("y2")
+    adm1 = svc.queue.offer(uuid, l2.ct.site_id, _delta_items(l2, left))
+    adm2 = svc.queue.offer(uuid, r2.ct.site_id,
+                           _delta_items(r2, right))
+    assert adm1.admitted and adm2.admitted
+    # chaos: the next tick crashes the service mid-steady-state
+    chaos.configure(plan={"seed": 7, "faults": [
+        {"family": "crash", "site": "serve.tick", "at": [1]}]})
+    with pytest.raises(ServiceCrashed):
+        svc.tick()
+    del svc  # ALL in-memory state gone: queue contents, sessions
+    svc2 = SyncService.restore(str(tmp_path / "ckpt"))
+    replays = [e for e in _events("serve.restored")]
+    assert replays and replays[-1]["fields"]["replayed"] == 3
+    oracle = CausalList(l2.ct.evolve(weaver="pure", lanes=None)).merge(
+        CausalList(r2.ct.evolve(weaver="pure", lanes=None)))
+    assert c.causal_to_edn(svc2.materialize(uuid)) \
+        == c.causal_to_edn(oracle)
+    # idempotence: replaying the same journal again changes nothing
+    svc3 = SyncService.restore(str(tmp_path / "ckpt"))
+    assert svc3.converged_digest(uuid) == svc2.converged_digest(uuid)
+
+
+def test_restore_preserves_admission_regime(tmp_path):
+    """A queue-less restore() rebuilds the MANIFEST's admission
+    bounds — a restart must not quietly relax max_ops/defer/deadline
+    (or residency capacity) back to library defaults."""
+    jr = IngestJournal(str(tmp_path / "wal.jsonl"))
+    q = IngestQueue(max_ops=97, defer_frac=0.5, defer_max=7,
+                    deadline_ms=1234.5, journal=jr)
+    svc = SyncService(q, residency=ResidencyManager(capacity=3),
+                      checkpoint_dir=str(tmp_path / "ckpt"), d_max=16)
+    base = _base()
+    a, b = _pair(base)
+    svc.add_tenant(a, b)
+    manifest = svc.drain()
+    svc2 = SyncService.restore(manifest)
+    assert svc2.queue.max_ops == 97
+    assert svc2.queue.defer_watermark == q.defer_watermark
+    assert svc2.queue.defer_max == 7
+    assert svc2.queue.deadline_ms == 1234.5
+    assert svc2.residency.capacity == 3
+    svc2.close()
+
+
+def test_drain_mid_crash_then_restore(tmp_path):
+    obs.configure(enabled=True)
+    svc = _service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    svc.checkpoint()
+    left, right = svc.residency.get(uuid).pairs[0]
+    l2 = left.conj("x1")
+    svc.queue.offer(uuid, l2.ct.site_id, _delta_items(l2, left))
+    chaos.configure(plan={"seed": 3, "faults": [
+        {"family": "crash", "site": "serve.drain", "at": [1]}]})
+    with pytest.raises(ServiceCrashed):
+        svc.drain()
+    del svc
+    chaos.reset()
+    svc2 = SyncService.restore(str(tmp_path / "ckpt"))
+    oracle = CausalList(l2.ct.evolve(weaver="pure", lanes=None)).merge(
+        CausalList(right.ct.evolve(weaver="pure", lanes=None)))
+    assert c.causal_to_edn(svc2.materialize(uuid)) \
+        == c.causal_to_edn(oracle)
+    # and a clean drain completes after the restore
+    manifest = svc2.drain()
+    assert os.path.exists(manifest)
+
+
+def test_service_tick_emits_vocabulary_and_controller_moves(tmp_path):
+    obs.configure(enabled=True)
+    svc = _service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    left, right = svc.residency.get(uuid).pairs[0]
+    l2 = left.conj("x1")
+    svc.queue.offer(uuid, l2.ct.site_id, _delta_items(l2, left))
+    svc.tick()
+    (tick,) = _events("serve.tick")
+    assert tick["fields"]["ops"] == 1
+    assert tick["fields"]["tenants"] == 1
+    assert tick["fields"]["t_batch_ms"] > 0
+    hb = [e for e in _events("run.heartbeat")
+          if e["fields"].get("stage") == "serve.tick"]
+    assert hb
+    # the live fold picks the serve axes up from this same stream
+    from cause_tpu.obs import live
+
+    fold = live.LiveFold()
+    fold.feed_many(obs.events())
+    snap = fold.snapshot()
+    assert snap["serve"]["active"] is True
+    assert snap["serve"]["ticks"] == 1
+    assert snap["serve"]["queue_depth"] == 0
+
+
+def test_service_watchdog_fires_once_per_excursion(tmp_path):
+    import time as _time
+
+    obs.configure(enabled=True)
+    svc = _service(tmp_path, watchdog_s=0.1)
+    svc.last_tick_us = _time.time_ns() // 1000
+    svc.start_watchdog()
+    try:
+        _time.sleep(0.5)
+    finally:
+        svc.stop_watchdog()
+    fired = _events("serve.watchdog")
+    assert len(fired) == 1, fired  # one event per excursion
+    assert fired[0]["fields"]["age_s"] > 0.1
+
+
+def test_service_obs_off_still_correct(tmp_path):
+    assert not obs.enabled()
+    svc = _service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    left, right = svc.residency.get(uuid).pairs[0]
+    l2 = left.conj("x1")
+    svc.queue.offer(uuid, l2.ct.site_id, _delta_items(l2, left))
+    svc.tick()
+    manifest = svc.drain()
+    svc2 = SyncService.restore(os.path.dirname(manifest))
+    oracle = CausalList(l2.ct.evolve(weaver="pure", lanes=None)).merge(
+        CausalList(right.ct.evolve(weaver="pure", lanes=None)))
+    assert c.causal_to_edn(svc2.materialize(uuid)) \
+        == c.causal_to_edn(oracle)
+    assert obs.events() == []
+
+
+# -------------------------------------------- live snapshot serve axes
+
+
+def test_live_snapshot_serve_fields_and_default_rules():
+    from cause_tpu.obs import live
+
+    specs = set(live.DEFAULT_RULE_SPECS)
+    assert "shed_rate>0" in specs
+    assert "absence:serve.tick:60" in specs
+    assert live.parse_rule("shed_rate>0").path == "serve.shed_rate"
+    assert live.parse_rule("queue_depth>100").path \
+        == "serve.queue_depth"
+    assert live.parse_rule("resident_docs>8").path \
+        == "serve.resident_docs"
+
+    fold = live.LiveFold()
+    # a batch stream: serve inactive, the serve absence rule silent
+    fold.feed({"ev": "event", "name": "wave.digest", "ts_us": 1,
+               "fields": {}})
+    snap = fold.snapshot(now_us=200_000_000)
+    assert snap["serve"]["active"] is False
+    mon = live.LiveMonitor(rules=["absence:serve.tick:60"], source="t")
+    mon.feed([{"ev": "event", "name": "wave.digest", "ts_us": 1,
+               "fields": {}}])
+    assert mon.evaluate(now_us=200_000_000) == []
+    # serve records flip it active; shed events mint the rate + alert
+    mon2 = live.LiveMonitor(rules=["shed_rate>0"], source="t")
+    t0 = 1_000_000
+    mon2.feed([
+        {"ev": "event", "name": "serve.tick", "ts_us": t0,
+         "fields": {"ops": 1}},
+        {"ev": "gauge", "name": "serve.queue_depth", "ts_us": t0,
+         "value": 7},
+        {"ev": "gauge", "name": "serve.resident_docs", "ts_us": t0,
+         "value": 3},
+        {"ev": "event", "name": "serve.shed", "ts_us": t0 + 1000,
+         "fields": {"rung": "reject"}},
+    ])
+    snap = mon2.snapshot(now_us=t0 + 2000)
+    assert snap["serve"]["active"] is True
+    assert snap["serve"]["queue_depth"] == 7
+    assert snap["serve"]["resident_docs"] == 3
+    assert snap["serve"]["sheds"] == 1
+    assert snap["serve"]["shed_rate"] > 0
+    fired = mon2.evaluate(now_us=t0 + 2000)
+    assert len(fired) == 1 and fired[0]["rule"] == "shed_rate>0"
+    # …and a service whose ticks stop fires the absence rule
+    mon3 = live.LiveMonitor(rules=["absence:serve.tick:60"],
+                            source="t")
+    mon3.feed([{"ev": "event", "name": "serve.tick", "ts_us": t0,
+                "fields": {}}])
+    fired = mon3.evaluate(now_us=t0 + 61_000_000)
+    assert len(fired) == 1
+    assert fired[0]["rule"] == "absence:serve.tick:60"
+
+
+def test_watch_renders_serve_line():
+    from cause_tpu.obs import live, watch
+
+    mon = live.LiveMonitor(source="t")
+    mon.feed([
+        {"ev": "event", "name": "serve.tick", "ts_us": 1_000_000,
+         "fields": {"ops": 2}},
+        {"ev": "gauge", "name": "serve.queue_depth",
+         "ts_us": 1_000_000, "value": 5},
+    ])
+    text = watch.render(mon.snapshot(now_us=2_000_000), [], ["x"])
+    assert "serve: 1 tick(s)" in text
+    assert "queue depth 5" in text
+    prom = watch.prometheus_text(mon.snapshot(now_us=2_000_000))
+    assert "cause_tpu_live_serve_queue_depth 5" in prom
