@@ -883,6 +883,52 @@ def check_srv001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                     "(or hoist it off the traced path)")
 
 
+# distinctive bare names for the network-transport layer (PR 13);
+# generic verbs and common helper names (pump/dial/read/write,
+# send_msg/recv_msg) are matched through the ``net``/``transport``
+# module qualifiers instead, or they would flag every socket/IPC
+# helper in the tree. The net package is HOST work by definition — it
+# blocks on sockets, sleeps out backoff ladders and takes connection
+# locks; none of that can ever sit inside a traced program, so
+# reaching it from jit-reachable code unguarded is a structural
+# smell exactly like SRV001's.
+_NET_APIS = frozenset(
+    {"NetClient", "ReplicationServer", "FrameStream", "Backoff",
+     "loopback_pair"}
+)
+
+
+@rule("NET001",
+      "network-transport API reached from jit-reachable code without "
+      "an obs.enabled() guard (the net layer blocks on sockets, "
+      "sleeps out reconnect backoff and takes connection locks — "
+      "host transport work that must never sit on a traced path)")
+def check_net001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module) or "net" in module.segments:
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]):
+                # the sanctioned guard spellings, as in OBS003-007
+                continue
+            is_net = (
+                parts[-1] in _NET_APIS
+                or any(p in ("net", "_net", "transport", "_transport")
+                       for p in parts[:-1])
+            )
+            if is_net and not guarded:
+                yield _finding(
+                    "NET001", module, call,
+                    f"{'.'.join(parts)}() on a jit-reachable path "
+                    "without an obs.enabled() guard — the net layer "
+                    "blocks on socket IO, sleeps out backoff ladders "
+                    "and mutates connection state; gate the call (or "
+                    "hoist it off the traced path)")
+
+
 # ----------------------------------------------------------------- LCA
 
 @rule("LCA001",

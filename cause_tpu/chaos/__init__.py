@@ -20,7 +20,15 @@ in the obs stream:
   serde checkpoint, losing all in-memory state) — ``scripts/soak.py
   --chaos`` acts on :func:`should_crash`;
 - **stall** faults sleep inside a wave to trip the PR-10
-  ``absence:run.heartbeat`` live-alert rule (the wedge detector).
+  ``absence:run.heartbeat`` live-alert rule (the wedge detector);
+- **net** faults (PR 13) disrupt the replication transport at the
+  wire level: ``partition`` refuses connect attempts (the dial-side
+  hook), ``reset`` closes an established connection mid-protocol,
+  ``latency`` sleeps before a frame send, ``blackhole`` silently
+  drops an outbound frame (the peer waits out its read deadline),
+  and ``dup`` sends one frame twice (same seq — the server's
+  wire-duplicate detector must count and re-ack it) — all caught by
+  ``cause_tpu/net``'s reconnect/backoff + watermark-resume machinery.
 
 Determinism: every fault spec keeps its own per-site invocation
 counter and its own ``random.Random((plan seed, spec index))`` stream,
@@ -61,12 +69,18 @@ __all__ = [
     "budget_exhaust",
     "should_crash",
     "stall_point",
+    "net_partition",
+    "net_reset",
+    "net_latency_ms",
+    "net_blackhole",
+    "net_dup",
     "injected",
     "chaos_report",
 ]
 
-FAMILIES = ("payload", "dispatch", "crash", "stall")
+FAMILIES = ("payload", "dispatch", "crash", "stall", "net")
 PAYLOAD_MODES = ("corrupt", "truncate", "duplicate", "reorder", "drop")
+NET_MODES = ("partition", "reset", "latency", "blackhole", "dup")
 # the value planted by payload corruption: tests and the chaos soak
 # gate grep converged documents for it — an admitted corruption is a
 # validation hole, not a flake
@@ -107,6 +121,10 @@ class _Fault:
             if self.mode not in ("raise", "exhaust"):
                 raise ValueError(
                     f"unknown dispatch mode: {self.mode!r}")
+        elif self.family == "net":
+            self.mode = self.mode or "reset"
+            if self.mode not in NET_MODES:
+                raise ValueError(f"unknown net mode: {self.mode!r}")
         self.at = frozenset(int(x) for x in (spec.get("at") or ()))
         self.prob = float(spec.get("prob") or 0.0)
         self.times = int(spec.get("times") or 0)
@@ -369,6 +387,75 @@ def stall_point(site: str) -> float:
     if dur:
         time.sleep(dur)
     return dur
+
+
+# ------------------------------------------------------- net (PR 13)
+#
+# Wire-level fault hooks for the replication transport. Each hook is
+# mode-filtered (a ``latency`` spec never advances at the ``reset``
+# hook and vice versa — the same rule the dispatch family follows),
+# so one plan can schedule independent partition/reset/latency/
+# blackhole/dup streams against the same site with per-spec
+# determinism. Site convention: the transport calls the dial-side
+# hook at ``<site>.connect`` and the frame-send hooks at
+# ``<site>.send``, so a spec's ``site`` of ``net.client`` matches
+# both via the prefix rule.
+
+
+def net_partition(site: str) -> bool:
+    """Whether a ``partition``-mode net fault refuses this connect
+    attempt (the dial raises its connection-refused path; the caller's
+    backoff ladder owns the retry). One invocation per dial."""
+    f = _decide(f"{site}.connect", "net", mode="partition")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def net_reset(site: str) -> bool:
+    """Whether a ``reset``-mode net fault kills the connection at this
+    frame send (the transport closes the socket; the peer sees EOF
+    mid-protocol)."""
+    f = _decide(f"{site}.send", "net", mode="reset")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def net_latency_ms(site: str) -> float:
+    """Milliseconds of injected latency before this frame send (the
+    spec's ``ms``, capped like stalls so no plan wedges a run for
+    real); 0.0 when nothing fired."""
+    f = _decide(f"{site}.send", "net", mode="latency")
+    if f is None:
+        return 0.0
+    dur_ms = min(max(f.ms, 0.0), _STALL_CAP_S * 1000.0)
+    _record(f, site, latency_ms=round(dur_ms, 3))
+    return dur_ms
+
+
+def net_blackhole(site: str) -> bool:
+    """Whether a ``blackhole``-mode net fault silently drops this
+    outbound frame (the send "succeeds", nothing crosses the wire —
+    the peer's read deadline is the only detector)."""
+    f = _decide(f"{site}.send", "net", mode="blackhole")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def net_dup(site: str) -> bool:
+    """Whether a ``dup``-mode net fault sends this frame twice (same
+    seq on the wire — the receiver's wire-duplicate detector must
+    count it and re-ack idempotently)."""
+    f = _decide(f"{site}.send", "net", mode="dup")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
 
 
 # ------------------------------------------------------------ report

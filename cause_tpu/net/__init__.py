@@ -1,0 +1,52 @@
+"""The partition-tolerant network transport (PR 13, ROADMAP item 4's
+cross-host follow-on).
+
+``cause_tpu.serve`` made admission a transport-shaped seam
+(``Admission.offer`` → write-ahead journal → bounded queue) but kept
+every replica in one process. This package is the wire: long-lived
+replication sessions connecting remote producers to a ``SyncService``
+across real sockets, designed partition-first — SafarDB's split
+(arXiv:2603.08003: host owns admission/ordering, accelerator owns
+merge) with the ingest ordering pushed into the network layer
+(arXiv:1605.05619):
+
+- :mod:`cause_tpu.net.transport` — framed endpoints over the
+  ``sync.send_frame`` CRC framing: unbuffered :class:`FrameStream`
+  with read deadlines, seeded-jitter exponential :class:`Backoff`,
+  :func:`dial` with the partition chaos hook, and the wire-level
+  fault seam (latency / reset / blackhole / dup) applied at the send
+  side, post-CRC;
+- :mod:`cause_tpu.net.session` — :class:`NetClient`: bounded outbound
+  queues with shed evidence, reconnect/backoff, heartbeats, NACK
+  backpressure honored, and resumable per-(tenant, site) lamport
+  watermarks negotiated at every (re)connect so a healed partition
+  ships exactly the missed suffix;
+- :mod:`cause_tpu.net.server` — :class:`ReplicationServer`: the
+  acceptor that turns inbound frames into ``Admission.offer`` calls,
+  NACKs sheds with their ``retry_after_ms`` hints, suppresses
+  idempotent re-delivery through the journal-seeded watermark,
+  detects + re-acks wire-duplicate frames, and rejects out-of-order
+  or tampered frames into the PR-11 offender/quarantine ladder.
+
+Acceptance instrument: ``scripts/net_soak.py`` — loopback clients
+under seeded partitions/resets/duplicated frames plus a mid-soak
+server crash+restore must reconverge bit-identical to the fault-free
+single-process oracle with zero admitted ops lost (``--kind net``
+ledger rows: reconnects, duplicates suppressed, partition MTTR,
+NACK/backoff histogram).
+
+Importable without jax — the transport is host work by design.
+"""
+
+from .transport import Backoff, FrameStream, dial, loopback_pair
+from .session import NetClient
+from .server import ReplicationServer
+
+__all__ = [
+    "Backoff",
+    "FrameStream",
+    "NetClient",
+    "ReplicationServer",
+    "dial",
+    "loopback_pair",
+]

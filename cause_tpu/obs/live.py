@@ -97,7 +97,8 @@ class LiveFold:
     __slots__ = ("fleet", "cost", "first_ts_us", "last_ts_us",
                  "last_seen_us", "_wave_ts", "headroom_min",
                  "headroom_last", "heartbeat", "serve_gauges",
-                 "_shed_ts", "shed_total", "serve_ticks")
+                 "_shed_ts", "shed_total", "serve_ticks",
+                 "net_gauges", "net_counts", "_reconnect_ts")
 
     def __init__(self):
         self.fleet = FleetReducer()
@@ -123,6 +124,16 @@ class LiveFold:
         self._shed_ts: deque = deque(maxlen=_RATE_TS_MAX)
         self.shed_total = 0
         self.serve_ticks = 0
+        # PR 13, the network transport's live axes: last-seen net
+        # gauges (outbound_depth / connections), event counts
+        # (connects, reconnects, nacks, duplicate evidence, sheds)
+        # and the reconnect timestamps behind reconnects_per_min —
+        # the flap detector. A stream with no net.* records renders
+        # net.active=False and the net rules stay silent (a batch
+        # soak is not a dead transport — it is not a transport).
+        self.net_gauges: Dict[str, float] = {}
+        self.net_counts: Dict[str, int] = {}
+        self._reconnect_ts: deque = deque(maxlen=_RATE_TS_MAX)
 
     def feed(self, e: dict) -> None:
         self.fleet.feed(e)
@@ -161,6 +172,17 @@ class LiveFold:
                 self.shed_total += 1
                 if isinstance(ts, int):
                     self._shed_ts.append(ts)
+            elif isinstance(name, str) and name.startswith("net."):
+                key = name[len("net."):]
+                self.net_counts[key] = self.net_counts.get(key, 0) + 1
+                if name == "net.reconnect" and isinstance(ts, int):
+                    self._reconnect_ts.append(ts)
+                elif name == "net.dup_ops":
+                    ops = (e.get("fields") or {}).get("ops")
+                    if isinstance(ops, (int, float)):
+                        self.net_counts["dup_ops_suppressed"] = \
+                            self.net_counts.get("dup_ops_suppressed",
+                                                0) + int(ops)
         elif ev == "gauge" and isinstance(name, str):
             if name.startswith("fleet.token_headroom."):
                 site = name[len("fleet.token_headroom."):]
@@ -174,6 +196,10 @@ class LiveFold:
                 v = e.get("value")
                 if isinstance(v, (int, float)):
                     self.serve_gauges[name[len("serve."):]] = v
+            elif name.startswith("net."):
+                v = e.get("value")
+                if isinstance(v, (int, float)):
+                    self.net_gauges[name[len("net."):]] = v
 
     def feed_many(self, events: Iterable[dict]) -> None:
         for e in events:
@@ -206,6 +232,31 @@ class LiveFold:
         cutoff = now_us - int(window_s * 1e6)
         n = sum(1 for t in self._shed_ts if t >= cutoff)
         return round(n / window_s, 4)
+
+    def reconnects_per_min(self, now_us: int,
+                           window_s: float = _RATE_WINDOW_S) -> float:
+        """``net.reconnect`` events per minute over the rate window —
+        the default ``reconnects_per_min>k`` alert's axis: a transport
+        that keeps healing is a transport that keeps failing (flap
+        detection), even though every individual reconnect is the
+        designed behavior."""
+        cutoff = now_us - int(window_s * 1e6)
+        n = sum(1 for t in self._reconnect_ts if t >= cutoff)
+        return round(n * 60.0 / window_s, 4)
+
+    def _net_outbound(self) -> Optional[float]:
+        """Total queued outbound ops across every client: the gauges
+        are per-client (``net.outbound_depth.<client_id>``) because a
+        single shared gauge would be last-writer-wins — one drained
+        client would mask another's growing partition backlog. The
+        bare un-suffixed spelling still counts (hand-rolled
+        streams)."""
+        vals = [v for k, v in self.net_gauges.items()
+                if k == "outbound_depth"
+                or k.startswith("outbound_depth.")]
+        if not vals:
+            return None
+        return sum(vals)
 
     def ages_s(self, now_us: int) -> Dict[str, float]:
         """Seconds since each event name was last seen (the absence
@@ -265,6 +316,24 @@ class LiveFold:
                 "shed_rate": self.shed_rate(now),
                 "sheds": self.shed_total,
             },
+            "net": {
+                "active": bool(self.net_counts or self.net_gauges
+                               or any(n.startswith("net.")
+                                      for n in self.last_seen_us)),
+                "connects": self.net_counts.get("connect", 0),
+                "reconnects": self.net_counts.get("reconnect", 0),
+                "reconnects_per_min": self.reconnects_per_min(now),
+                "disconnects": self.net_counts.get("disconnect", 0),
+                "nacks": self.net_counts.get("nack", 0),
+                "dup_frames": self.net_counts.get("dup_frame", 0),
+                "dup_ops_suppressed":
+                    self.net_counts.get("dup_ops_suppressed", 0),
+                "ooo_frames": self.net_counts.get("ooo_frame", 0),
+                "sheds": self.net_counts.get("shed", 0),
+                "heartbeats": self.net_counts.get("heartbeat", 0),
+                "outbound_depth": self._net_outbound(),
+                "connections": self.net_gauges.get("connections"),
+            },
             "ages_s": self.ages_s(now),
         }
         if self.cost.waves:
@@ -319,6 +388,13 @@ RULE_ALIASES = {
     "queue_depth": "serve.queue_depth",
     "shed_rate": "serve.shed_rate",
     "resident_docs": "serve.resident_docs",
+    # PR 13: the network transport's axes — reconnect flap rate, wire
+    # NACK count, client outbound backlog, duplicate evidence
+    "reconnects_per_min": "net.reconnects_per_min",
+    "net_nacks": "net.nacks",
+    "net_outbound": "net.outbound_depth",
+    "net_dup_frames": "net.dup_frames",
+    "net_connections": "net.connections",
 }
 
 _OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -373,13 +449,15 @@ class Rule:
                 # never seen: judge against the stream's own span —
                 # other records flowing while this event stays absent
                 # IS the wedge shape; a silent (empty) stream is not.
-                # Exception: serve.* events are judged only on streams
-                # that show serve activity — a batch soak that never
-                # ran a service is not a dead service, it is not a
-                # service at all (the default absence:serve.tick rule
+                # Exception: serve.*/net.* events are judged only on
+                # streams that show the respective activity — a batch
+                # soak that never ran a service (or a transport) is
+                # not a dead one, it is not one at all (the default
+                # absence:serve.tick / absence:net.heartbeat rules
                 # must not page on every long batch stream)
-                if not self.event.startswith("serve.") \
-                        or (snap.get("serve") or {}).get("active"):
+                prefix = self.event.split(".", 1)[0]
+                if prefix not in ("serve", "net") \
+                        or (snap.get(prefix) or {}).get("active"):
                     age = snap.get("span_s")
             if age is None or age <= self.window_s:
                 return None
@@ -458,7 +536,21 @@ DEFAULT_RULE_SPECS = ("burn>2", "absence:wave.digest:120",
                       # heartbeat goes absent for 60 s — the in-stream
                       # twin of SyncService's own watchdog, inert on
                       # streams with no serve activity (Rule._condition)
-                      "shed_rate>0", "absence:serve.tick:60")
+                      "shed_rate>0", "absence:serve.tick:60",
+                      # PR 13, the transport pair: a replication link
+                      # whose heartbeat evidence goes absent for 120 s
+                      # (clients keepalive on a seconds cadence, so
+                      # this is a genuinely dead/blackholed transport,
+                      # not an idle one), and a reconnect FLAP — more
+                      # than 6 heals a minute means the link keeps
+                      # dying; each individual reconnect is designed
+                      # behavior, the sustained rate is the incident.
+                      # Both inert on streams with no net activity
+                      # (absence via Rule._condition's activity gate;
+                      # the threshold reads a rate that stays 0.0
+                      # until net.reconnect records flow)
+                      "absence:net.heartbeat:120",
+                      "reconnects_per_min>6")
 
 
 def default_rules() -> List[Rule]:
@@ -613,6 +705,18 @@ class LiveMonitor:
                 resident_docs=srv.get("resident_docs"),
                 t_batch_ms=srv.get("t_batch_ms"),
                 serve_ticks=srv.get("ticks"),
+            )
+        net = snap.get("net") or {}
+        if net.get("active"):
+            # the transport's axes ride along only when a transport
+            # actually ran (same contract as the serve section)
+            fields.update(
+                net_reconnects=net.get("reconnects"),
+                reconnects_per_min=net.get("reconnects_per_min"),
+                net_nacks=net.get("nacks"),
+                net_dup_frames=net.get("dup_frames"),
+                net_dup_ops=net.get("dup_ops_suppressed"),
+                net_outbound=net.get("outbound_depth"),
             )
         if core.enabled():
             core.event("live.snapshot", **fields)

@@ -94,6 +94,14 @@ SEMANTIC_EVENT_PREFIXES = (
     # swim-lanes over the wave spans they disrupted
     "chaos.",
     "recovery.",
+    # PR 12/13: the serve-loop and network-transport vocabularies —
+    # admission sheds/ticks, and the wire's connect/reconnect/
+    # heartbeat/nack/duplicate evidence — each on its own named
+    # track, so a partition investigation reads disconnect ->
+    # backoff -> reconnect -> resumed-suffix swim-lanes over the
+    # serve ticks they starved
+    "serve.",
+    "net.",
 )
 
 

@@ -149,6 +149,17 @@ def render(snap: dict, alerts: List[dict], paths: List[str],
             f"T_batch {_g(srv.get('t_batch_ms'))} ms; "
             f"{srv.get('sheds', 0)} shed(s) "
             f"({_g(srv.get('shed_rate'))}/s)")
+    net = snap.get("net") or {}
+    if net.get("active"):
+        lines.append(
+            f"  net: {_g(net.get('connections'))} connection(s), "
+            f"{net.get('connects', 0)} connect(s) "
+            f"({net.get('reconnects', 0)} re, "
+            f"{_g(net.get('reconnects_per_min'))}/min), "
+            f"{net.get('nacks', 0)} nack(s), "
+            f"{net.get('dup_frames', 0)} dup frame(s) "
+            f"+ {net.get('dup_ops_suppressed', 0)} op(s) suppressed, "
+            f"outbound {_g(net.get('outbound_depth'))}")
     hb = snap.get("heartbeat")
     if hb:
         hb_age = ages.get("run.heartbeat")
@@ -213,6 +224,19 @@ _PROM_METRICS = (
     ("cause_tpu_live_serve_shed_rate", "serve.shed_rate", "gauge"),
     ("cause_tpu_live_serve_sheds_total", "serve.sheds", "counter"),
     ("cause_tpu_live_serve_t_batch_ms", "serve.t_batch_ms", "gauge"),
+    ("cause_tpu_live_net_connections", "net.connections", "gauge"),
+    ("cause_tpu_live_net_connects_total", "net.connects", "counter"),
+    ("cause_tpu_live_net_reconnects_total", "net.reconnects",
+     "counter"),
+    ("cause_tpu_live_net_reconnects_per_min",
+     "net.reconnects_per_min", "gauge"),
+    ("cause_tpu_live_net_nacks_total", "net.nacks", "counter"),
+    ("cause_tpu_live_net_dup_frames_total", "net.dup_frames",
+     "counter"),
+    ("cause_tpu_live_net_dup_ops_total", "net.dup_ops_suppressed",
+     "counter"),
+    ("cause_tpu_live_net_outbound_depth", "net.outbound_depth",
+     "gauge"),
     ("cause_tpu_live_alerts_total", "alerts_total", "counter"),
 )
 

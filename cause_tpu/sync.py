@@ -55,6 +55,7 @@ __all__ = [
     "any_quarantined",
     "quarantined",
     "note_reject",
+    "note_clean",
     "readmit",
     "quarantine_reset",
     "send_frame",
@@ -297,10 +298,12 @@ def note_reject(peer: str, uuid: str = "", why: str = "") -> int:
     return n
 
 
-def _note_clean(peer: str) -> None:
+def note_clean(peer: str) -> None:
     """A validated payload from ``peer`` landed: the consecutive
     -reject counter resets (quarantine itself only lifts via
-    :func:`readmit`)."""
+    :func:`readmit`). Public since PR 13 — the net server's ingest
+    boundary resets offenders exactly like a sync round does (a wire
+    corruption is transient; only CONSECUTIVE rejects quarantine)."""
     peer = str(peer or "")
     if not peer:
         return
@@ -357,11 +360,26 @@ def send_frame(stream, obj: dict) -> None:
 
 def _read_exact(stream, n: int) -> bytes:
     """Accumulate exactly ``n`` bytes. Raw sockets and unbuffered pipes
-    may legally return short reads; only an empty read means EOF."""
+    may legally return short reads; only an empty read means EOF. A
+    stream whose deadline expires (a socket with a timeout set, or the
+    net transport's ``FrameStream``) raises the protocol's uniform
+    ``read-timeout`` CausalError instead of leaking ``TimeoutError`` —
+    the caller treats both as "this peer is dead, degrade/reconnect"."""
     chunks = []
     got = 0
     while got < n:
-        chunk = stream.read(n - got)
+        try:
+            chunk = stream.read(n - got)
+        except TimeoutError:
+            # socket.timeout is TimeoutError since 3.10: a silent peer
+            # on a deadline-armed stream is a protocol outcome, not a
+            # crash — reject uniformly so every caller's except
+            # CausalError ladder (full-bag retry, transport reconnect)
+            # handles it
+            raise s.CausalError(
+                "sync read deadline exceeded",
+                {"causes": {"read-timeout"}},
+            ) from None
         if not chunk:
             raise s.CausalError("sync stream closed mid-frame",
                                 {"causes": {"eof"}})
@@ -370,7 +388,21 @@ def _read_exact(stream, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def recv_frame(stream) -> dict:
+def _arm_deadline(stream, timeout_s: Optional[float]) -> None:
+    """Arm a read deadline on a stream that supports one (sockets and
+    the net transport's ``FrameStream`` expose ``settimeout``; plain
+    buffered file objects don't — for those, set the timeout on the
+    underlying socket BEFORE ``makefile()`` and ``_read_exact`` maps
+    the raised ``TimeoutError`` to the uniform reject)."""
+    if timeout_s is None:
+        return
+    settimeout = getattr(stream, "settimeout", None)
+    if settimeout is not None:
+        settimeout(float(timeout_s))
+
+
+def recv_frame(stream, timeout_s: Optional[float] = None) -> dict:
+    _arm_deadline(stream, timeout_s)
     (n,) = _HDR.unpack(_read_exact(stream, _HDR.size))
     if n > MAX_FRAME:
         raise s.CausalError("sync frame too large",
@@ -378,7 +410,8 @@ def recv_frame(stream) -> dict:
     return json.loads(_read_exact(stream, n))
 
 
-def exchange_frame(stream, obj: dict) -> dict:
+def exchange_frame(stream, obj: dict,
+                   read_timeout_s: Optional[float] = None) -> dict:
     """Send ``obj`` and receive the peer's frame CONCURRENTLY. Both
     sync endpoints are symmetric (each sends, then expects the peer's
     frame of the same kind); writing a large frame before reading
@@ -395,7 +428,7 @@ def exchange_frame(stream, obj: dict) -> dict:
     t = threading.Thread(target=_send, daemon=True)
     t.start()
     try:
-        got = recv_frame(stream)
+        got = recv_frame(stream, timeout_s=read_timeout_s)
         # bounded even on success: a peer that answered and then
         # stopped draining would otherwise hang this join forever. The
         # bound is generous (SEND_DRAIN_TIMEOUT) because a slow uplink
@@ -415,11 +448,21 @@ def exchange_frame(stream, obj: dict) -> dict:
         t.join(timeout=1.0)
         raise
     if err:
+        if isinstance(err[0], TimeoutError):
+            # the armed deadline is socket-wide, so a peer that stops
+            # DRAINING can time out our send thread too — map it to
+            # the same uniform CausalError family the read path uses,
+            # or the caller's except-CausalError degrade ladder would
+            # miss it and crash on a bare TimeoutError
+            raise s.CausalError(
+                "sync peer stopped draining mid-frame",
+                {"causes": {"send-stalled"}},
+            ) from err[0]
         raise err[0]
     return got
 
 
-def sync_stream(handle, stream):
+def sync_stream(handle, stream, read_timeout_s: Optional[float] = None):
     """One symmetric anti-entropy round over a duplex byte stream (a
     socket ``makefile('rwb')``, a pipe pair, ...). Both ends call this;
     returns the converged handle.
@@ -430,8 +473,19 @@ def sync_stream(handle, stream):
     exchanging the full bag of nodes. Every exchange is concurrent
     send+recv (``exchange_frame``) so arbitrarily large frames cannot
     deadlock the symmetric protocol.
+
+    ``read_timeout_s`` is the transport's read deadline (PR 13): a
+    peer that connects and then goes silent used to wedge the reader
+    forever on the first blocking receive — with a deadline armed, the
+    round rejects with the uniform ``read-timeout`` CausalError
+    instead. The deadline is armed through the stream's ``settimeout``
+    when it has one (sockets, the net transport's ``FrameStream``);
+    buffered ``makefile()`` streams should arm the timeout on the
+    underlying socket instead — either way the raised ``TimeoutError``
+    maps to the same reject (tests/test_sync.py pins both spellings).
     """
     ct = handle.ct
+    _arm_deadline(stream, read_timeout_s)
     if obs.enabled():
         # wedge-triage heartbeat (PR 10): before the first blocking
         # exchange, so a live monitor can tell "a sync round started
@@ -515,7 +569,7 @@ def sync_stream(handle, stream):
                 handle,
                 checked_decode(frame_field(delta, "delta", "nodes"),
                                delta.get("crc")))
-            _note_clean(peer_site)
+            note_clean(peer_site)
         except s.CausalError as e:
             if _is_payload_reject(e):
                 # the validate-before-apply boundary: the poisoned
@@ -612,7 +666,7 @@ def sync_pair(a, b) -> Tuple[object, object]:
             mangled = _chaos.mangle_items(enc, "sync.delta")
             try:
                 nodes = checked_decode(mangled, crc)
-                _note_clean(peer)
+                note_clean(peer)
             except s.CausalError as e:
                 if not _is_payload_reject(e):
                     raise

@@ -1,0 +1,72 @@
+"""Known-bad NET001 fixture: network-transport APIs on a traced path.
+Only the unguarded calls gate — every OBS003-007/CHS001/SRV001 guard
+spelling (nested if, aliased import, early return, negated-test else)
+is sanctioned here too, and generic verbs (``conn.read``/``x.pump``)
+on non-net objects must never be flagged."""
+
+import jax
+
+from cause_tpu import net
+from cause_tpu import net as _net
+from cause_tpu import obs
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    net.dial("127.0.0.1", 9)                         # NET001: unguarded
+    if obs.enabled():
+        cl = net.NetClient("127.0.0.1", 9, [])       # guarded: fine
+        cl.pump()
+    if _obs_enabled():
+        # the aliased module spelling is fine under the aliased guard
+        _net.Backoff(seed=3)
+    return x * 2
+
+
+@jax.jit
+def traced_bare_name(x):
+    # distinctive bare names gate without a module qualifier too
+    from cause_tpu.net import NetClient
+
+    NetClient("127.0.0.1", 9, [])                    # NET001: unguarded
+    return x + 1
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    net.loopback_pair()
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful transport call), its ELSE branch is
+    # obs-on only (guarded: fine)
+    if not obs.enabled():
+        net.Backoff(seed=1)                          # NET001
+    else:
+        net.Backoff(seed=1)                          # fine
+    return x
+
+
+class _NotNet:
+    def pump(self, *a):
+        return a
+
+    def read(self, n):
+        return b""
+
+
+@jax.jit
+def traced_generic_verbs_ok(x):
+    # pump()/read() on an arbitrary object are NOT net APIs — the
+    # rule matches the net module qualifier or distinctive names only
+    conn = _NotNet()
+    conn.pump()
+    conn.read(4)
+    return x

@@ -206,6 +206,23 @@ def test_serve_unguarded_call_on_traced_path():
     assert rules_of(res) == ["SRV001"]
 
 
+def test_net_unguarded_call_on_traced_path():
+    """NET001 (PR-13): the network-transport layer blocks on sockets,
+    sleeps out reconnect backoff and mutates connection state — host
+    transport work that must never sit on a traced path unguarded.
+    Exactly three findings — the plain unguarded module-qualified
+    call, a distinctive bare name, and the body of a negated test;
+    every OBS003-007/CHS001/SRV001 guard spelling is sanctioned, and
+    generic verbs (pump/read) on non-net objects never flag."""
+    res = run_api(os.path.join(FIX, "net_caller_bad.py"))
+    net = [f for f in res.findings if f.rule == "NET001"]
+    assert len(net) == 3, [f.message for f in net]
+    assert "net.dial" in net[0].message
+    assert "NetClient" in net[1].message
+    assert "net.Backoff" in net[2].message
+    assert rules_of(res) == ["NET001"]
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -321,7 +338,8 @@ def test_cli_exit_codes():
     "obs_caller_bad.py", "devprof_caller_bad.py",
     "semantic_caller_bad.py", "costmodel_caller_bad.py",
     "lag_caller_bad.py", "live_caller_bad.py",
-    "chaos_caller_bad.py", "serve_caller_bad.py", "lca_bad.py",
+    "chaos_caller_bad.py", "serve_caller_bad.py", "net_caller_bad.py",
+    "lca_bad.py",
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -332,8 +350,8 @@ def test_cli_list_rules():
     assert out.returncode == 0
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
-                "OBS006", "OBS007", "CHS001", "SRV001", "LCA001",
-                "GEN001"):
+                "OBS006", "OBS007", "CHS001", "SRV001", "NET001",
+                "LCA001", "GEN001"):
         assert rid in out.stdout
 
 

@@ -1,0 +1,620 @@
+"""PR 13: the partition-tolerant network transport.
+
+Pins the wire's four contracts:
+
+- **framing + deadlines** — CRC-framed messages over unbuffered
+  socket streams; a silent peer trips the uniform ``read-timeout``
+  reject inside the deadline, a dead peer reads as ``eof``;
+- **resumable watermarks** — a (re)connect negotiates per-(tenant,
+  site) lamport watermarks and ships EXACTLY the missed suffix: no
+  re-applied ops, duplicate counters exact, the write-ahead journal
+  carries every admitted op once;
+- **backpressure + refusals over the wire** — a shed becomes a NACK
+  with ``retry_after_ms`` the client honors; poison payloads NACK
+  through the offender ladder; wire-duplicate frames re-ack without
+  re-admission; out-of-order frames reject;
+- **graceful degradation** — resets/blackholes/partitions degrade to
+  queued outbound deltas + seeded backoff, never a wedge or an
+  exception on the caller's loop, and the bounded outbound queue
+  sheds with evidence.
+"""
+
+import time
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import chaos, obs, serde, sync
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id
+from cause_tpu.net import (Backoff, NetClient, ReplicationServer,
+                           loopback_pair, transport)
+from cause_tpu.serve import IngestJournal, IngestQueue, SyncService
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    for k in ("CAUSE_TPU_CHAOS", "CAUSE_TPU_OBS", "CAUSE_TPU_OBS_OUT"):
+        monkeypatch.delenv(k, raising=False)
+    chaos.reset()
+    obs.reset()
+    sync.quarantine_reset()
+    yield
+    chaos.reset()
+    obs.reset()
+    sync.quarantine_reset()
+
+
+def _events(name=None):
+    evs = [e for e in obs.events() if e.get("ev") == "event"]
+    if name is None:
+        return evs
+    return [e for e in evs if e.get("name") == name]
+
+
+def _base(n=12):
+    base = CausalList(c_list.weave(
+        c.clist(weaver="jax").extend(["w"] * n).ct
+    ))
+    base.ct.lanes.segments()
+    return base
+
+
+def _service(tmp_path, max_ops=256, d_max=16, n_tenants=1):
+    """One SyncService + its tenants (deferral disabled: net-facing
+    queues promote outside the wire watermark's view — the server
+    docstring's caveat)."""
+    q = IngestQueue(max_ops=max_ops, defer_frac=1.0,
+                    journal=IngestJournal(str(tmp_path / "wal.jsonl")))
+    svc = SyncService(q, checkpoint_dir=str(tmp_path), d_max=d_max)
+    uuids = []
+    pairs = {}
+    for i in range(n_tenants):
+        # a fresh clist per tenant: evolve() keeps the doc uuid, and
+        # tenants are keyed by it — shared-base replicas would all
+        # collapse into ONE tenant
+        base = _base()
+        a = CausalList(base.ct.evolve(site_id=new_site_id())).conj(
+            f"A{i}")
+        b = CausalList(base.ct.evolve(site_id=new_site_id())).conj(
+            f"B{i}")
+        uuid = svc.add_tenant(a, b)
+        uuids.append(uuid)
+        pairs[uuid] = (a, b)
+    return svc, uuids, pairs
+
+
+def _mint(site, n, start_ts=1000, cause=None):
+    """``n`` chained ops on one site (a thin producer's yarn)."""
+    out = []
+    last = cause if cause is not None else c.root_id
+    ts = start_ts
+    for i in range(n):
+        ts += 1
+        nid = (ts, site, 0)
+        out.append((nid, last, f"op{ts}"))
+        last = nid
+    return out
+
+
+def _journal_entries(journal_path):
+    """Read the WAL back through IngestJournal itself — one torn-line
+    and format authority, never a reimplementation."""
+    jr = IngestJournal(journal_path)
+    entries = sorted(jr.iter_from(0), key=lambda e: int(e["seq"]))
+    jr.close()
+    return entries
+
+
+def _pure_oracle(pairs, journal_path):
+    """The fault-free single-process oracle: the tenant's pure pair
+    merge plus a pure replay of the whole write-ahead journal."""
+    out = {}
+    for uuid, (a, b) in pairs.items():
+        pa = CausalList(a.ct.evolve(weaver="pure", lanes=None))
+        pb = CausalList(b.ct.evolve(weaver="pure", lanes=None))
+        out[uuid] = pa.merge(pb)
+    for e in _journal_entries(journal_path):
+        nodes = serde.decode_node_items(e["items"])
+        out[str(e["uuid"])] = sync.apply_delta(
+            out[str(e["uuid"])], nodes, _count_as_delta=False)
+    return out
+
+
+def _journal_ids(journal_path):
+    return [tuple(it[0]) for e in _journal_entries(journal_path)
+            for it in e["items"]]
+
+
+# --------------------------------------------------------- transport
+
+
+def test_frame_stream_roundtrip_and_eof():
+    fa, fb = loopback_pair()
+    transport.send_msg(fa, {"op": "ping", "seq": 7})
+    assert transport.recv_msg(fb, timeout_s=2.0) == {"op": "ping",
+                                                     "seq": 7}
+    fa.close()
+    with pytest.raises(c.CausalError) as ei:
+        transport.recv_msg(fb, timeout_s=2.0)
+    assert "eof" in ei.value.info["causes"]
+    fb.close()
+
+
+def test_frame_stream_read_deadline():
+    """A connected-but-silent peer trips the uniform read-timeout
+    reject inside the deadline — never a wedge."""
+    fa, fb = loopback_pair()
+    t0 = time.monotonic()
+    with pytest.raises(c.CausalError) as ei:
+        transport.recv_msg(fb, timeout_s=0.2)
+    assert "read-timeout" in ei.value.info["causes"]
+    assert time.monotonic() - t0 < 2.0
+    fa.close()
+    fb.close()
+
+
+def test_backoff_seeded_deterministic_and_capped():
+    b1 = Backoff(base_ms=50, cap_ms=400, seed=7)
+    b2 = Backoff(base_ms=50, cap_ms=400, seed=7)
+    seq1 = [b1.next_ms() for _ in range(6)]
+    seq2 = [b2.next_ms() for _ in range(6)]
+    assert seq1 == seq2, "same seed must give the same schedule"
+    assert Backoff(base_ms=50, cap_ms=400, seed=8).next_ms() != seq1[0]
+    # exponential growth into the cap, jitter in [1/2, 1)
+    for i, d in enumerate(seq1):
+        raw = min(400.0, 50.0 * 2 ** i)
+        assert raw * 0.5 <= d < raw
+    # reset rewinds the exponent, not the stream
+    b1.reset()
+    assert b1.attempt == 0
+    assert 25.0 <= b1.next_ms() < 50.0
+
+
+def test_dial_unreachable_is_uniform_causal_error():
+    with pytest.raises(c.CausalError) as ei:
+        transport.dial("127.0.0.1", 1, connect_timeout_s=0.5)
+    assert "net-unreachable" in ei.value.info["causes"]
+
+
+def test_chaos_net_hooks_off_invariance():
+    """With chaos unset every net hook is inert — no faults, no state,
+    no records."""
+    assert not chaos.enabled()
+    assert chaos.net_partition("net.client") is False
+    assert chaos.net_reset("net.client") is False
+    assert chaos.net_latency_ms("net.client") == 0.0
+    assert chaos.net_blackhole("net.client") is False
+    assert chaos.net_dup("net.client") is False
+    assert chaos.injected() == []
+
+
+def test_chaos_net_partition_schedule_is_seeded_exact():
+    """A partition plan's ``at`` schedule refuses exactly the connect
+    attempts it names — per-spec counters, deterministic."""
+    chaos.configure(plan={"seed": 3, "faults": [
+        {"family": "net", "mode": "partition", "site": "net.client",
+         "at": [1, 2]}]})
+    for _ in range(2):
+        with pytest.raises(c.CausalError) as ei:
+            transport.dial("127.0.0.1", 1, connect_timeout_s=0.2)
+        assert ei.value.info.get("injected") is True
+    # third attempt reaches the (real, refused) socket instead
+    with pytest.raises(c.CausalError) as ei:
+        transport.dial("127.0.0.1", 1, connect_timeout_s=0.2)
+    assert "injected" not in ei.value.info
+    assert len([r for r in chaos.injected()
+                if r["family"] == "net"]) == 2
+
+
+# ------------------------------------------------------- end to end
+
+
+def test_end_to_end_replication_and_oracle_identity(tmp_path):
+    svc, (uuid,), pairs = _service(tmp_path)
+    srv = ReplicationServer(svc).start()
+    try:
+        cl = NetClient("127.0.0.1", srv.port, [uuid], client_id="e2e",
+                       read_timeout_s=2.0)
+        site = new_site_id()
+        ops = _mint(site, 5)
+        assert cl.queue_ops(uuid, site, ops)
+        st = cl.pump()
+        assert st["connected"] and st["outbound_ops"] == 0, st
+        assert st["acked_ops"] == 5
+        svc.tick()
+        doc = svc.materialize(uuid)
+        oracle = _pure_oracle(pairs, svc.queue.journal.path)[uuid]
+        assert dict(doc.ct.nodes) == dict(oracle.ct.nodes)
+        assert c.causal_to_edn(doc) == c.causal_to_edn(oracle)
+        assert srv.stats["admitted_ops"] == 5
+        assert srv.stats["dup_ops_suppressed"] == 0
+        cl.close()
+    finally:
+        srv.stop()
+
+
+def test_reconnect_resume_ships_exactly_the_missed_suffix(tmp_path):
+    """The satellite pin: kill a client mid-session, reconnect (same
+    client object AND a fresh one with the full history re-queued) —
+    the watermark negotiation ships exactly the missed suffix: no
+    re-applied ops, duplicate counters exact, every op once in the
+    journal, bit-identical to the pure oracle."""
+    svc, (uuid,), pairs = _service(tmp_path)
+    srv = ReplicationServer(svc).start()
+    try:
+        site = new_site_id()
+        all_ops = _mint(site, 8)
+        cl = NetClient("127.0.0.1", srv.port, [uuid], client_id="r1",
+                       read_timeout_s=2.0,
+                       backoff=Backoff(base_ms=1, cap_ms=5, seed=1))
+        assert cl.queue_ops(uuid, site, all_ops[:5])
+        cl.pump()
+        assert cl.stats["acked_ops"] == 5
+        # the link dies under the client (it does not notice yet —
+        # the raw socket drops, the FrameStream still looks open)
+        cl._fs.sock.close()
+        assert cl.queue_ops(uuid, site, all_ops[5:])
+        # first pump hits the dead socket -> degrade to queued +
+        # backoff (no exception), second pump reconnects and resumes
+        st = cl.pump()
+        assert not st["connected"]
+        assert st["outbound_ops"] == 3
+        deadline = time.monotonic() + 5.0
+        while cl.outbound_depth and time.monotonic() < deadline:
+            cl.pump()
+            time.sleep(0.002)
+        assert cl.outbound_depth == 0
+        assert cl.stats["reconnects"] == 1
+        assert cl.stats["acked_ops"] == 8
+        # exactly the missed suffix shipped: nothing suppressed, no op
+        # journaled twice
+        assert srv.stats["admitted_ops"] == 8
+        assert srv.stats["dup_ops_suppressed"] == 0
+        assert srv.stats["dup_frames"] == 0
+        jids = _journal_ids(svc.queue.journal.path)
+        assert len(jids) == len(set(jids)) == 8
+        cl.close()
+
+        # a FRESH client (crashed producer restart: re-queues its
+        # whole history) — the welcome watermark filters client-side
+        # and ships NOTHING new
+        cl2 = NetClient("127.0.0.1", srv.port, [uuid], client_id="r2",
+                        read_timeout_s=2.0)
+        assert cl2.queue_ops(uuid, site, all_ops)
+        st = cl2.pump()
+        assert st["outbound_ops"] == 0
+        assert cl2.stats["resumed_skipped_ops"] == 8
+        assert cl2.stats["sent_frames"] == 0, \
+            "a fully-admitted history must ship zero frames"
+        assert srv.stats["admitted_ops"] == 8
+        jids = _journal_ids(svc.queue.journal.path)
+        assert len(jids) == len(set(jids)) == 8
+        cl2.close()
+
+        svc.tick()
+        doc = svc.materialize(uuid)
+        oracle = _pure_oracle(pairs, svc.queue.journal.path)[uuid]
+        assert dict(doc.ct.nodes) == dict(oracle.ct.nodes)
+        assert c.causal_to_edn(doc) == c.causal_to_edn(oracle)
+    finally:
+        srv.stop()
+
+
+def test_watermark_suppresses_redelivery_and_wire_dups(tmp_path):
+    """Raw protocol: a re-delivered frame (lost-ack shape) is
+    suppressed op-exactly by the server watermark; the SAME seq again
+    is a wire duplicate — counted, re-acked, never re-admitted."""
+    obs.configure(enabled=True)
+    svc, (uuid,), _pairs = _service(tmp_path)
+    srv = ReplicationServer(svc).start()
+    try:
+        site = new_site_id()
+        ops = _mint(site, 4)
+        enc = serde.encode_node_items(
+            {t[0]: (t[1], t[2]) for t in ops})
+        crc = sync.payload_checksum(enc)
+        fs = transport.dial("127.0.0.1", srv.port)
+        transport.send_msg(fs, {"op": "hello", "client": "raw",
+                                "uuids": [uuid]})
+        w = transport.recv_msg(fs, timeout_s=2.0)
+        assert w["op"] == "welcome" and w["wm"][uuid] == {}
+        frame = {"op": "delta", "seq": 1, "uuid": uuid, "site": site,
+                 "nodes": enc, "crc": crc}
+        transport.send_msg(fs, frame)
+        r1 = transport.recv_msg(fs, timeout_s=2.0)
+        assert r1 == {"op": "ack", "seq": 1, "admitted": 4, "dup": 0}
+        # lost-ack redelivery: new seq, same ops -> all suppressed
+        frame2 = dict(frame, seq=2)
+        transport.send_msg(fs, frame2)
+        r2 = transport.recv_msg(fs, timeout_s=2.0)
+        assert r2 == {"op": "ack", "seq": 2, "admitted": 0, "dup": 4}
+        assert srv.stats["dup_ops_suppressed"] == 4
+        # wire duplicate: same seq -> stored reply re-sent, counted
+        transport.send_msg(fs, frame2)
+        r3 = transport.recv_msg(fs, timeout_s=2.0)
+        assert r3 == r2
+        assert srv.stats["dup_frames"] == 1
+        # out-of-order: an older seq rejects
+        transport.send_msg(fs, dict(frame, seq=1))
+        r4 = transport.recv_msg(fs, timeout_s=2.0)
+        assert r4 == {"op": "nack", "seq": 1, "reason": "out-of-order"}
+        assert srv.stats["ooo_frames"] == 1
+        # once in the journal, once in the doc
+        jids = _journal_ids(svc.queue.journal.path)
+        assert len(jids) == len(set(jids)) == 4
+        # the evidence is in the stream
+        assert len(_events("net.dup_ops")) == 1
+        assert len(_events("net.dup_frame")) == 1
+        assert len(_events("net.ooo_frame")) == 1
+        fs.close()
+    finally:
+        srv.stop()
+
+
+def test_nack_backpressure_is_honored(tmp_path):
+    """A capacity shed becomes a wire NACK with a retry hint; the
+    client parks the session until it elapses — overload flows back
+    to the sender instead of a hot retry loop."""
+    obs.configure(enabled=True)
+    svc, (uuid,), _pairs = _service(tmp_path, max_ops=4)
+    srv = ReplicationServer(svc).start()
+    try:
+        cl = NetClient("127.0.0.1", srv.port, [uuid], client_id="bp",
+                       read_timeout_s=2.0)
+        s1, s2 = new_site_id(), new_site_id()
+        assert cl.queue_ops(uuid, s1, _mint(s1, 3, start_ts=2000))
+        assert cl.queue_ops(uuid, s2, _mint(s2, 3, start_ts=3000))
+        cl.pump()
+        # first batch admitted (depth 3), second NACKed at capacity
+        assert cl.stats["acked_ops"] == 3
+        assert cl.stats["nacks"] == {"capacity": 1}
+        assert cl.outbound_depth == 3
+        nacks = _events("net.nack")
+        assert len(nacks) == 1
+        assert nacks[0]["fields"]["reason"] == "capacity"
+        # parked: an immediate pump sends nothing
+        frames_before = cl.stats["sent_frames"]
+        cl.pump()
+        assert cl.stats["sent_frames"] == frames_before
+        # the service drains; after the hint elapses the retry admits
+        svc.tick()
+        deadline = time.monotonic() + 5.0
+        while cl.outbound_depth and time.monotonic() < deadline:
+            cl.pump()
+            time.sleep(0.01)
+        assert cl.outbound_depth == 0
+        assert srv.stats["admitted_ops"] == 6
+        cl.close()
+    finally:
+        srv.stop()
+
+
+def test_poison_payload_nacks_through_offender_ladder(tmp_path):
+    """A chaos-reordered wire payload rejects at the validate
+    boundary (out-of-order items = tampering), lands sync.reject
+    evidence through note_reject, and the clean retry heals — no
+    quarantine from one transient wire fault."""
+    obs.configure(enabled=True)
+    chaos.configure(plan={"seed": 5, "faults": [
+        {"family": "payload", "site": "net.delta", "mode": "reorder",
+         "at": [1]}]})
+    svc, (uuid,), pairs = _service(tmp_path)
+    srv = ReplicationServer(svc).start()
+    try:
+        cl = NetClient("127.0.0.1", srv.port, [uuid], client_id="poi",
+                       read_timeout_s=2.0)
+        site = new_site_id()
+        assert cl.queue_ops(uuid, site, _mint(site, 3))
+        cl.pump()  # mangled -> poison NACK
+        assert srv.stats["poison_nacks"] == 1
+        assert sum(cl.stats["nacks"].values()) == 1
+        assert len(_events("sync.reject")) == 1
+        assert not sync.is_quarantined(site)
+        deadline = time.monotonic() + 5.0
+        while cl.outbound_depth and time.monotonic() < deadline:
+            cl.pump()
+            time.sleep(0.01)
+        assert cl.outbound_depth == 0, "clean retry must heal"
+        svc.tick()
+        doc = svc.materialize(uuid)
+        oracle = _pure_oracle(pairs, svc.queue.journal.path)[uuid]
+        assert dict(doc.ct.nodes) == dict(oracle.ct.nodes)
+        cl.close()
+    finally:
+        srv.stop()
+
+
+def test_blackhole_degrades_to_reconnect_and_resume(tmp_path):
+    """A blackholed frame (sent, never arrives) is detected only by
+    the read deadline; the session reconnects and the watermark
+    resume ships the suffix — zero loss, zero duplicates."""
+    # send #1 is the hello, #2 the delta frame — blackhole the delta
+    chaos.configure(plan={"seed": 9, "faults": [
+        {"family": "net", "mode": "blackhole", "site": "net.client",
+         "at": [2]}]})
+    svc, (uuid,), _pairs = _service(tmp_path)
+    srv = ReplicationServer(svc).start()
+    try:
+        cl = NetClient("127.0.0.1", srv.port, [uuid], client_id="bh",
+                       read_timeout_s=0.3,
+                       backoff=Backoff(base_ms=1, cap_ms=5, seed=2))
+        site = new_site_id()
+        assert cl.queue_ops(uuid, site, _mint(site, 4))
+        cl.pump()  # frame vanishes -> read-timeout -> disconnected
+        assert not cl.connected
+        assert cl.outbound_depth == 4
+        deadline = time.monotonic() + 5.0
+        while cl.outbound_depth and time.monotonic() < deadline:
+            cl.pump()
+            time.sleep(0.002)
+        assert cl.outbound_depth == 0
+        assert cl.stats["reconnects"] == 1
+        assert srv.stats["admitted_ops"] == 4
+        assert srv.stats["dup_ops_suppressed"] == 0
+        jids = _journal_ids(svc.queue.journal.path)
+        assert len(jids) == len(set(jids)) == 4
+        cl.close()
+    finally:
+        srv.stop()
+
+
+def test_client_outbound_queue_is_bounded_with_shed_evidence():
+    obs.configure(enabled=True)
+    cl = NetClient("127.0.0.1", 1, ["u"], client_id="shed",
+                   max_pending_ops=5)
+    site = new_site_id()
+    assert cl.queue_ops("u", site, _mint(site, 4))
+    assert not cl.queue_ops("u", site, _mint(site, 3, start_ts=5000))
+    assert cl.outbound_depth == 4, "refused ops were never queued"
+    assert cl.stats["shed_ops"] == 3
+    sheds = _events("net.shed")
+    assert len(sheds) == 1
+    f = sheds[0]["fields"]
+    assert f["rung"] == "client-overflow" and f["ops"] == 3
+
+
+def test_idle_connection_closes_with_evidence(tmp_path):
+    obs.configure(enabled=True)
+    svc, (uuid,), _pairs = _service(tmp_path)
+    srv = ReplicationServer(svc, idle_timeout_s=0.2).start()
+    try:
+        fs = transport.dial("127.0.0.1", srv.port)
+        transport.send_msg(fs, {"op": "hello", "client": "quiet",
+                                "uuids": [uuid]})
+        transport.recv_msg(fs, timeout_s=2.0)
+        deadline = time.monotonic() + 5.0
+        while not srv.stats["idle_closes"] \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert srv.stats["idle_closes"] == 1
+        assert len(_events("net.idle_close")) == 1
+        fs.close()
+    finally:
+        srv.stop()
+
+
+def test_heartbeat_keeps_session_alive_and_evidenced(tmp_path):
+    obs.configure(enabled=True)
+    svc, (uuid,), _pairs = _service(tmp_path)
+    srv = ReplicationServer(svc, idle_timeout_s=1.0).start()
+    try:
+        cl = NetClient("127.0.0.1", srv.port, [uuid], client_id="hb",
+                       read_timeout_s=2.0, heartbeat_s=0.05)
+        cl.pump()  # connect
+        deadline = time.monotonic() + 5.0
+        while cl.stats["heartbeats"] < 2 \
+                and time.monotonic() < deadline:
+            cl.pump()
+            time.sleep(0.06)
+        assert cl.stats["heartbeats"] >= 2
+        assert cl.connected
+        hb = _events("net.heartbeat")
+        sides = {e["fields"].get("side") for e in hb}
+        assert {"client", "server"} <= sides
+        cl.close()
+    finally:
+        srv.stop()
+
+
+def test_net_layer_obs_off_emits_nothing(tmp_path):
+    """The obs-off invariance contract holds for the whole net layer:
+    a full replication round with obs disabled mints zero records."""
+    assert not obs.enabled()
+    svc, (uuid,), _pairs = _service(tmp_path)
+    srv = ReplicationServer(svc).start()
+    try:
+        cl = NetClient("127.0.0.1", srv.port, [uuid], client_id="off",
+                       read_timeout_s=2.0)
+        site = new_site_id()
+        assert cl.queue_ops(uuid, site, _mint(site, 3))
+        cl.pump()
+        assert cl.stats["acked_ops"] == 3
+        assert obs.events() == []
+        cl.close()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------- live rules
+
+
+def _ev(name, ts_us, **fields):
+    return {"ev": "event", "name": name, "ts_us": ts_us,
+            "fields": fields}
+
+
+def test_live_fold_net_section_and_flap_rule():
+    from cause_tpu.obs import live
+
+    fold = live.LiveFold()
+    t0 = 1_000_000_000
+    fold.feed(_ev("net.connect", t0, side="client"))
+    for i in range(7):
+        fold.feed(_ev("net.reconnect", t0 + (i + 1) * 1_000_000))
+    fold.feed(_ev("net.nack", t0 + 2_000_000, reason="capacity"))
+    fold.feed(_ev("net.dup_ops", t0 + 3_000_000, ops=4))
+    fold.feed({"ev": "gauge", "name": "net.outbound_depth",
+               "value": 12, "ts_us": t0 + 3_000_000})
+    snap = fold.snapshot(now_us=t0 + 8_000_000)
+    net = snap["net"]
+    assert net["active"] is True
+    assert net["connects"] == 1 and net["reconnects"] == 7
+    assert net["reconnects_per_min"] == 7.0
+    assert net["nacks"] == 1 and net["dup_ops_suppressed"] == 4
+    assert net["outbound_depth"] == 12
+    # the flap rule fires exactly once per excursion
+    rule = live.parse_rule("reconnects_per_min>6")
+    assert rule.check(snap)["value"] == 7.0
+    assert rule.check(snap) is None
+
+
+def test_net_default_rules_inert_without_net_activity():
+    from cause_tpu.obs import live
+
+    specs = set(live.DEFAULT_RULE_SPECS)
+    assert "absence:net.heartbeat:120" in specs
+    assert "reconnects_per_min>6" in specs
+    monitor = live.LiveMonitor()
+    t0 = 1_000_000_000
+    # a long batch stream with zero net activity: both net rules
+    # stay silent even though net.heartbeat was never seen
+    monitor.feed([_ev("wave.digest", t0, agreed=True, pairs=1,
+                      valid=1, distinct=1, uuid="u", source="wave",
+                      wave=1, staleness={"0": 1}),
+                  _ev("wave.digest", t0 + 300_000_000, agreed=True,
+                      pairs=1, valid=1, distinct=1, uuid="u",
+                      source="wave", wave=2, staleness={"0": 1})])
+    fired = monitor.evaluate(now_us=t0 + 300_000_000)
+    assert not [a for a in fired
+                if "net" in a["rule"] or "reconnects" in a["rule"]]
+
+
+def test_net_heartbeat_absence_fires_on_active_transport():
+    from cause_tpu.obs import live
+
+    monitor = live.LiveMonitor(rules=["absence:net.heartbeat:120"])
+    t0 = 1_000_000_000
+    monitor.feed([_ev("net.connect", t0, side="client"),
+                  _ev("serve.tick", t0 + 200_000_000, ops=0)])
+    fired = monitor.evaluate(now_us=t0 + 200_000_000)
+    assert len(fired) == 1 and fired[0]["event"] == "net.heartbeat"
+
+
+def test_watch_renders_net_line_and_prometheus(tmp_path):
+    from cause_tpu.obs import live, watch
+
+    monitor = live.LiveMonitor()
+    t0 = 1_000_000_000
+    monitor.feed([_ev("net.connect", t0),
+                  _ev("net.reconnect", t0 + 1_000_000),
+                  _ev("net.heartbeat", t0 + 1_500_000, side="client"),
+                  {"ev": "gauge", "name": "net.outbound_depth",
+                   "value": 3, "ts_us": t0 + 1_500_000}])
+    snap = monitor.snapshot(now_us=t0 + 2_000_000)
+    block = watch.render(snap, [], ["x.jsonl"])
+    assert "net: " in block and "1 re" in block
+    prom = watch.prometheus_text(snap)
+    assert "cause_tpu_live_net_reconnects_total 1" in prom
+    assert "cause_tpu_live_net_outbound_depth 3" in prom

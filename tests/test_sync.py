@@ -276,6 +276,66 @@ def test_exchange_frame_surfaces_recv_error_while_send_blocked():
     assert time.monotonic() - t0 < 30, "exchange_frame hung on join"
 
 
+def test_sync_stream_read_deadline_on_silent_peer():
+    """The PR-13 fix: a peer that connects and then goes silent used
+    to wedge the reader forever on the first blocking receive — with
+    the transport's read deadline armed, the round rejects with the
+    uniform read-timeout CausalError inside the deadline. Pinned in
+    both spellings: the deadline armed through sync_stream's own
+    read_timeout_s (a settimeout-capable stream — the net transport's
+    FrameStream), and a socket timeout armed by the caller under a
+    buffered makefile stream."""
+    import time as _time
+
+    from cause_tpu.net.transport import FrameStream
+
+    base = c.clist("x")
+
+    # (a) sync_stream arms the deadline itself via stream.settimeout
+    s1, s2 = socket.socketpair()
+    t0 = _time.monotonic()
+    with pytest.raises(c.CausalError) as ei:
+        sync.sync_stream(base, FrameStream(s1), read_timeout_s=0.3)
+    assert "read-timeout" in ei.value.info["causes"]
+    assert _time.monotonic() - t0 < 5.0, "reader wedged past deadline"
+    s1.close(); s2.close()
+
+    # (b) a buffered makefile stream with the timeout armed on the
+    # socket: the raised TimeoutError maps to the same uniform reject
+    s1, s2 = socket.socketpair()
+    s1.settimeout(0.3)
+    t0 = _time.monotonic()
+    with s1, s1.makefile("rwb") as stream:
+        with pytest.raises(c.CausalError) as ei:
+            sync.sync_stream(base, stream)
+        assert "read-timeout" in ei.value.info["causes"]
+    assert _time.monotonic() - t0 < 5.0
+    s2.close()
+
+
+def test_sync_stream_deadline_does_not_break_healthy_rounds():
+    """A generous deadline on a healthy round changes nothing — both
+    ends converge exactly as without one."""
+    base = c.clist(*"shared")
+    a = fork(base, CausalList).extend(["A1"])
+    b = fork(base, CausalList).extend(["B1"])
+    s1, s2 = socket.socketpair()
+    out = {}
+
+    from cause_tpu.net.transport import FrameStream
+
+    def side(name, handle, sock):
+        with sock:
+            out[name] = sync.sync_stream(handle, FrameStream(sock),
+                                         read_timeout_s=30.0)
+
+    t1 = threading.Thread(target=side, args=("a", a, s1))
+    t2 = threading.Thread(target=side, args=("b", b, s2))
+    t1.start(); t2.start(); t1.join(15); t2.join(15)
+    assert out["a"].get_nodes() == out["b"].get_nodes()
+    assert c.causal_to_edn(out["a"]) == c.causal_to_edn(out["b"])
+
+
 def test_same_ts_tx_run_partial_peer_heals():
     """Ids are (ts, site, tx); one transaction mints same-ts runs. A
     peer holding only a prefix of such a run must still receive the
