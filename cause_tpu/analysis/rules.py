@@ -929,6 +929,53 @@ def check_net001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                     "hoist it off the traced path)")
 
 
+# distinctive bare names for the durable-storage layer (PR 15);
+# generic verbs (append/gc/scan/close) are matched through the
+# ``wal``/``scrub`` module qualifiers instead, or they would flag
+# every list.append in the tree. The WAL/scrubber are HOST storage
+# work by definition — they hold file locks, fsync descriptors and
+# walk whole segment directories; none of that can ever sit inside a
+# traced program, so reaching it from jit-reachable code unguarded is
+# a structural smell exactly like SRV001's/NET001's.
+_DSK_APIS = frozenset(
+    {"WriteAheadLog", "open_journal", "scrub_wal",
+     "scrub_checkpoints", "bench_fsync"}
+)
+
+
+@rule("DSK001",
+      "WAL/scrubber API reached from jit-reachable code without an "
+      "obs.enabled() guard (the durable-storage layer fsyncs file "
+      "descriptors, rotates/retires segment files and walks segment "
+      "directories re-checking CRCs — host storage work that must "
+      "never sit on a traced path)")
+def check_dsk001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module) or "serve" in module.segments:
+        return
+    for info in ctx.reachable_funcs(module):
+        for call, guarded in _calls_with_guards(info):
+            parts = dotted_parts(call.func)
+            if parts is None:
+                continue
+            if _is_enabled_name(parts[-1]):
+                # the sanctioned guard spellings, as in OBS003-007
+                continue
+            is_wal = (
+                parts[-1] in _DSK_APIS
+                or any(p in ("wal", "_wal", "scrub", "_scrub")
+                       for p in parts[:-1])
+            )
+            if is_wal and not guarded:
+                yield _finding(
+                    "DSK001", module, call,
+                    f"{'.'.join(parts)}() on a jit-reachable path "
+                    "without an obs.enabled() guard — the durable-"
+                    "storage layer fsyncs descriptors, rotates and "
+                    "retires segment files and re-checks CRCs over "
+                    "whole directories; gate the call (or hoist it "
+                    "off the traced path)")
+
+
 # ----------------------------------------------------------------- LCA
 
 @rule("LCA001",

@@ -28,7 +28,18 @@ in the obs stream:
   drops an outbound frame (the peer waits out its read deadline),
   and ``dup`` sends one frame twice (same seq — the server's
   wire-duplicate detector must count and re-ack it) — all caught by
-  ``cause_tpu/net``'s reconnect/backoff + watermark-resume machinery.
+  ``cause_tpu/net``'s reconnect/backoff + watermark-resume machinery;
+- **disk** faults (PR 15) misbehave at the durable-storage seams:
+  ``torn`` writes a prefix of a WAL record and fails the append (a
+  crash mid-write — the op is never acknowledged, the tear is found
+  by the next scan), ``bitrot`` flips one byte of an acked record's
+  durable copy (the per-record CRC32 trailer is the detector),
+  ``enospc`` refuses the write outright (admission must shed on the
+  durability rung, never ack), ``fsync`` fails a flush-to-media call
+  (the WAL rotates to a fresh segment with evidence), and ``rename``
+  fails the atomic manifest/GC rename (the previous manifest must
+  stay intact) — all caught by ``cause_tpu/serve/wal.py`` and the
+  checkpoint path, scrubbed by ``python -m cause_tpu.serve scrub``.
 
 Determinism: every fault spec keeps its own per-site invocation
 counter and its own ``random.Random((plan seed, spec index))`` stream,
@@ -74,13 +85,19 @@ __all__ = [
     "net_latency_ms",
     "net_blackhole",
     "net_dup",
+    "disk_torn",
+    "disk_bitrot",
+    "disk_enospc",
+    "disk_fsync_fail",
+    "disk_rename_fail",
     "injected",
     "chaos_report",
 ]
 
-FAMILIES = ("payload", "dispatch", "crash", "stall", "net")
+FAMILIES = ("payload", "dispatch", "crash", "stall", "net", "disk")
 PAYLOAD_MODES = ("corrupt", "truncate", "duplicate", "reorder", "drop")
 NET_MODES = ("partition", "reset", "latency", "blackhole", "dup")
+DISK_MODES = ("torn", "bitrot", "enospc", "fsync", "rename")
 # the value planted by payload corruption: tests and the chaos soak
 # gate grep converged documents for it — an admitted corruption is a
 # validation hole, not a flake
@@ -125,6 +142,10 @@ class _Fault:
             self.mode = self.mode or "reset"
             if self.mode not in NET_MODES:
                 raise ValueError(f"unknown net mode: {self.mode!r}")
+        elif self.family == "disk":
+            self.mode = self.mode or "torn"
+            if self.mode not in DISK_MODES:
+                raise ValueError(f"unknown disk mode: {self.mode!r}")
         self.at = frozenset(int(x) for x in (spec.get("at") or ()))
         self.prob = float(spec.get("prob") or 0.0)
         self.times = int(spec.get("times") or 0)
@@ -452,6 +473,80 @@ def net_dup(site: str) -> bool:
     seq on the wire — the receiver's wire-duplicate detector must
     count it and re-ack idempotently)."""
     f = _decide(f"{site}.send", "net", mode="dup")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+# ------------------------------------------------------ disk (PR 15)
+#
+# Durable-storage fault hooks for the WAL/checkpoint write seams.
+# Mode-filtered like the net family (a ``torn`` spec never advances at
+# the fsync hook and vice versa), so one plan schedules independent
+# torn/bitrot/enospc/fsync/rename streams with per-spec determinism.
+# Site convention: the WAL calls the record-write hooks at
+# ``<site>.write``, the flush-to-media hook at ``<site>.fsync`` and
+# the atomic-rename hooks at ``<site>.rename``, so a spec's ``site``
+# of ``serve.wal`` (or ``serve.checkpoint``) matches via the prefix
+# rule. The hooks only SCHEDULE; the storage layer owns the actual
+# misbehavior (write the torn prefix, flip the byte, raise ENOSPC) —
+# same split as ``should_crash``.
+
+
+def disk_torn(site: str) -> bool:
+    """Whether a ``torn``-mode disk fault tears this record write (the
+    WAL writes a prefix of the line and fails the append — a crash
+    mid-write; the op is never acknowledged and the next scan counts
+    the tear)."""
+    f = _decide(f"{site}.write", "disk", mode="torn")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def disk_bitrot(site: str, nbytes: int, **details) -> Optional[int]:
+    """The byte index a ``bitrot``-mode disk fault flips in this
+    record's durable copy (None when nothing fired). The caller's
+    ``details`` ride the injection log — the soak's oracle reads the
+    intact ground truth back from there, since the whole point of the
+    fault is that the on-disk copy no longer has it."""
+    f = _decide(f"{site}.write", "disk", mode="bitrot")
+    if f is None or nbytes <= 0:
+        return None
+    idx = f.rng.randrange(int(nbytes))
+    _record(f, site, index=idx, nbytes=int(nbytes), **details)
+    return idx
+
+
+def disk_enospc(site: str) -> bool:
+    """Whether an ``enospc``-mode disk fault refuses this write (the
+    WAL raises its unappendable error; admission must refuse with the
+    durability shed rung — an unappendable journal never acks)."""
+    f = _decide(f"{site}.write", "disk", mode="enospc")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def disk_fsync_fail(site: str) -> bool:
+    """Whether a ``fsync``-mode disk fault fails this flush-to-media
+    call (the WAL rotates to a fresh segment with evidence — a file
+    descriptor that failed fsync has undefined durable state)."""
+    f = _decide(f"{site}.fsync", "disk", mode="fsync")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def disk_rename_fail(site: str) -> bool:
+    """Whether a ``rename``-mode disk fault fails this atomic
+    manifest/GC rename (the caller must keep the previous manifest
+    intact and surface the failure loudly)."""
+    f = _decide(f"{site}.rename", "disk", mode="rename")
     if f is None:
         return False
     _record(f, site)

@@ -125,7 +125,19 @@ class ReplicationServer:
         Sites absent from the journal resolve to "send everything";
         their overlap, if any, is suppressed op-by-op by the same
         watermark filter. Tenants registered later start empty — they
-        have no wire history by construction. Called under _wm_lock."""
+        have no wire history by construction. Called under _wm_lock.
+
+        The journal is duck-typed on the ``iter_from`` contract: the
+        PR-12 single-file ``IngestJournal`` and the PR-15 segmented
+        ``WriteAheadLog`` both seed here unchanged (the WAL's scan
+        spans every live segment in seq order). Segments retired by
+        post-checkpoint GC held only ops every tenant has applied AND
+        checkpointed, so a watermark seeded from the surviving suffix
+        can be conservative (lower) but never wrong: a client that
+        re-ships ops from the retired range lands merges that are
+        idempotent no-ops on state the packs already carry — the
+        fail-safe direction, same as a site with no journal history
+        at all."""
         journal = getattr(self.queue, "journal", None)
         tenants = getattr(self.service, "tenants", {})
         if journal is not None:

@@ -98,7 +98,8 @@ class LiveFold:
                  "last_seen_us", "_wave_ts", "headroom_min",
                  "headroom_last", "heartbeat", "serve_gauges",
                  "_shed_ts", "shed_total", "serve_ticks",
-                 "net_gauges", "net_counts", "_reconnect_ts")
+                 "net_gauges", "net_counts", "_reconnect_ts",
+                 "disk_faults", "journal_torn")
 
     def __init__(self):
         self.fleet = FleetReducer()
@@ -134,6 +135,13 @@ class LiveFold:
         self.net_gauges: Dict[str, float] = {}
         self.net_counts: Dict[str, int] = {}
         self._reconnect_ts: deque = deque(maxlen=_RATE_TS_MAX)
+        # PR 15, the durable-storage axes: every evidenced storage
+        # degradation (``serve.disk``: torn/bitrot/enospc/fsync/
+        # rename) and every torn-or-corrupt journal line surfaced by
+        # a replay (``serve.journal_torn`` carries the per-replay
+        # counts in its fields)
+        self.disk_faults = 0
+        self.journal_torn = 0
 
     def feed(self, e: dict) -> None:
         self.fleet.feed(e)
@@ -172,6 +180,16 @@ class LiveFold:
                 self.shed_total += 1
                 if isinstance(ts, int):
                     self._shed_ts.append(ts)
+            elif name == "serve.disk":
+                self.disk_faults += 1
+            elif name == "serve.journal_torn":
+                f = e.get("fields") or {}
+                n = 0
+                for k in ("skipped", "corrupt"):
+                    v = f.get(k)
+                    if isinstance(v, (int, float)):
+                        n += int(v)
+                self.journal_torn += max(1, n)
             elif isinstance(name, str) and name.startswith("net."):
                 key = name[len("net."):]
                 self.net_counts[key] = self.net_counts.get(key, 0) + 1
@@ -315,6 +333,10 @@ class LiveFold:
                 "t_batch_ms": self.serve_gauges.get("t_batch_ms"),
                 "shed_rate": self.shed_rate(now),
                 "sheds": self.shed_total,
+                "disk_faults": self.disk_faults,
+                "journal_torn": self.journal_torn,
+                "wal_segments": self.serve_gauges.get("wal_segments"),
+                "wal_bytes": self.serve_gauges.get("wal_bytes"),
             },
             "net": {
                 "active": bool(self.net_counts or self.net_gauges
@@ -395,6 +417,12 @@ RULE_ALIASES = {
     "net_outbound": "net.outbound_depth",
     "net_dup_frames": "net.dup_frames",
     "net_connections": "net.connections",
+    # PR 15: the durable-storage axes — evidenced storage faults,
+    # torn/corrupt journal lines seen by replays, live WAL size
+    "disk_faults": "serve.disk_faults",
+    "journal_torn": "serve.journal_torn",
+    "wal_bytes": "serve.wal_bytes",
+    "wal_segments": "serve.wal_segments",
 }
 
 _OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -550,7 +578,21 @@ DEFAULT_RULE_SPECS = ("burn>2", "absence:wave.digest:120",
                       # the threshold reads a rate that stays 0.0
                       # until net.reconnect records flow)
                       "absence:net.heartbeat:120",
-                      "reconnects_per_min>6")
+                      "reconnects_per_min>6",
+                      # PR 15, the storage pair: ANY evidenced disk
+                      # fault (torn write, bit-rot, ENOSPC, failed
+                      # fsync/rename — each one is a degradation the
+                      # operator should know happened even though the
+                      # service absorbed it), and ANY torn/corrupt
+                      # journal line surfaced by a replay (a torn tail
+                      # is expected after a crash, CRC corruption
+                      # never is — both deserve a page, not a buried
+                      # counter). Inert on serve-less streams: both
+                      # paths live under the snapshot's "serve"
+                      # section, whose counters stay 0 with no serve
+                      # records, and Rule._condition's activity gate
+                      # keeps them silent there
+                      "disk_faults>0", "journal_torn>0")
 
 
 def default_rules() -> List[Rule]:

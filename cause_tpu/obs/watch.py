@@ -149,6 +149,13 @@ def render(snap: dict, alerts: List[dict], paths: List[str],
             f"T_batch {_g(srv.get('t_batch_ms'))} ms; "
             f"{srv.get('sheds', 0)} shed(s) "
             f"({_g(srv.get('shed_rate'))}/s)")
+        if srv.get("wal_bytes") is not None or srv.get("disk_faults") \
+                or srv.get("journal_torn"):
+            lines.append(
+                f"  wal: {_g(srv.get('wal_segments'))} segment(s), "
+                f"{_g(srv.get('wal_bytes'))} bytes; "
+                f"{srv.get('disk_faults', 0)} disk fault(s), "
+                f"{srv.get('journal_torn', 0)} torn/corrupt line(s)")
     net = snap.get("net") or {}
     if net.get("active"):
         lines.append(
@@ -224,6 +231,13 @@ _PROM_METRICS = (
     ("cause_tpu_live_serve_shed_rate", "serve.shed_rate", "gauge"),
     ("cause_tpu_live_serve_sheds_total", "serve.sheds", "counter"),
     ("cause_tpu_live_serve_t_batch_ms", "serve.t_batch_ms", "gauge"),
+    ("cause_tpu_live_serve_disk_faults_total", "serve.disk_faults",
+     "counter"),
+    ("cause_tpu_live_serve_journal_torn_total", "serve.journal_torn",
+     "counter"),
+    ("cause_tpu_live_serve_wal_segments", "serve.wal_segments",
+     "gauge"),
+    ("cause_tpu_live_serve_wal_bytes", "serve.wal_bytes", "gauge"),
     ("cause_tpu_live_net_connections", "net.connections", "gauge"),
     ("cause_tpu_live_net_connects_total", "net.connects", "counter"),
     ("cause_tpu_live_net_reconnects_total", "net.reconnects",

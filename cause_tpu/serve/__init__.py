@@ -34,7 +34,18 @@ when the OFFERED LOAD, not the operator, decides what happens next?
   heartbeats with a watchdog, graceful drain (stop admission → flush
   queue → converge → checkpoint), and restore-from-checkpoint that
   replays the ingest journal above each tenant's applied watermark and
-  resumes steady-state delta waves.
+  resumes steady-state delta waves;
+- :mod:`cause_tpu.serve.wal` — the durable-storage lifecycle (PR 15):
+  a segmented write-ahead log with per-record CRC32 trailers,
+  size/age rotation, an fsync policy (``none``/``batch``/``always``),
+  and crash-safe post-checkpoint GC bounding long-running disk usage
+  — drop-in for ``IngestJournal`` (same record schema + ``iter_from``
+  contract), with the chaos ``disk`` family injected at its write
+  seams;
+- :mod:`cause_tpu.serve.scrub` — the offline storage scrubber
+  (``python -m cause_tpu.serve scrub``): walks WAL segments and
+  checkpoint packs, reports CRC failures / torn records / GC-eligible
+  bytes, exits nonzero on corruption.
 
 Import discipline: this ``__init__`` and the host-side modules
 (ingest, controller) are importable without jax — jax-touching pieces
@@ -47,6 +58,7 @@ at multiples of the measured steady-state rate, with and without
 
 from .ingest import Admission, IngestJournal, IngestQueue
 from .controller import BatchController
+from .wal import WriteAheadLog, open_journal
 
 __all__ = [
     "Admission",
@@ -56,6 +68,8 @@ __all__ = [
     "ResidencyManager",
     "ServiceCrashed",
     "SyncService",
+    "WriteAheadLog",
+    "open_journal",
 ]
 
 

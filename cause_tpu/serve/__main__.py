@@ -1,0 +1,10 @@
+"""``python -m cause_tpu.serve`` — the storage scrubber CLI
+(:mod:`cause_tpu.serve.scrub`). Jax-free: runs against a dead
+service's directories from a bare operator shell."""
+
+import sys
+
+from .scrub import cli
+
+if __name__ == "__main__":
+    sys.exit(cli())

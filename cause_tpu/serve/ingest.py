@@ -29,6 +29,12 @@ it is designed around three refusals:
   3. ``drop_oldest`` — the defer buffer overflowing drops its OLDEST
      *unadmitted* entry to make room.
 
+  PR 15 adds an orthogonal ``durability`` rung: when the write-ahead
+  journal itself refuses the append (ENOSPC, torn write — the chaos
+  ``disk`` family or a real storage fault), the offer is refused with
+  ``retry_after_ms`` instead of acknowledged — an unappendable
+  journal must NEVER ack, or a crash would lose an "admitted" op.
+
   Every shed — every rung — is one evidenced ``serve.shed`` event
   plus counters, so ``scripts/serve_soak.py`` can gate "every shed
   evidenced" machine-to-machine against the queue's own stats.
@@ -62,6 +68,10 @@ _HOT_MEAN_TTL_US = 100_000  # cached fleet-mean hotness lifetime
 _COLD_FRAC = 0.5
 # drain-rate EMA smoothing (per drain call)
 _RATE_ALPHA = 0.3
+# backpressure hint when the JOURNAL refuses the write and no drain
+# rate is measured yet: storage faults are transient on the chaos
+# timescale, so a short fixed retry beats no hint at all
+_DURABILITY_RETRY_MS = 50.0
 
 
 class Admission:
@@ -222,7 +232,8 @@ class IngestQueue:
             "poison_rejects": 0, "quarantine_refusals": 0,
             "unknown_tenant_rejects": 0,
             "sheds": 0, "shed_ops": 0, "max_depth": 0,
-            "shed_by_rung": {"defer": 0, "reject": 0, "drop_oldest": 0},
+            "shed_by_rung": {"defer": 0, "reject": 0,
+                             "drop_oldest": 0, "durability": 0},
             "deferred_promoted": 0,
         }
 
@@ -422,9 +433,24 @@ class IngestQueue:
             self._deferred = deque(
                 d for d in self._deferred
                 if not (d.uuid == uuid and d.site == site))
-        # WRITE-AHEAD: journal first, acknowledge after
+        # WRITE-AHEAD: journal first, acknowledge after. An
+        # unappendable journal must never ack — the durability rung
+        # refuses the offer with a retry hint and the producer
+        # re-offers once storage recovers (zero ADMITTED ops lost:
+        # this op was never admitted)
         if self.journal is not None:
-            seq = self.journal.append(uuid, site, items, ts_us=now)
+            try:
+                seq = self.journal.append(uuid, site, items, ts_us=now)
+            except (s.CausalError, OSError) as e:
+                causes = getattr(e, "info", {}).get("causes", ())
+                reason = next(iter(causes), "journal-error")
+                retry = self._retry_after_ms(ops)
+                if retry is None:
+                    retry = _DURABILITY_RETRY_MS
+                self._shed("durability", reason, uuid, site, ops,
+                           retry_after_ms=retry)
+                return Admission(False, rung="durability",
+                                 reason=reason, retry_after_ms=retry)
         else:
             self._seq += 1
             seq = self._seq

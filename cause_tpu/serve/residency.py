@@ -151,6 +151,36 @@ class ResidencyManager:
         self.insert(uuid, sess)
         return sess
 
+    def sweep_spill(self) -> int:
+        """Retention for the spill directory (PR 15: spill packs join
+        the post-checkpoint GC policy): remove every ``*.ckpt.json``
+        pack no longer backing a spilled tenant — a restored tenant's
+        leftover pack, a crashed process's stale tmp — and return the
+        bytes reclaimed. Live packs (anything ``self._spilled`` points
+        at) are never touched."""
+        if not self.spill_dir:
+            return 0
+        live = {os.path.basename(p) for p in self._spilled.values()
+                if isinstance(p, str)}
+        freed = 0
+        try:
+            names = os.listdir(self.spill_dir)
+        except OSError:
+            return 0
+        for name in names:
+            if name in live:
+                continue
+            if not (name.endswith(".ckpt.json") or ".tmp." in name):
+                continue
+            fp = os.path.join(self.spill_dir, name)
+            try:
+                nb = os.path.getsize(fp)
+                os.unlink(fp)
+            except OSError:  # pragma: no cover - sweep is best-effort
+                continue
+            freed += nb
+        return freed
+
     # ---------------------------------------------------- checkpointing
 
     def checkpoint_all(self, out_dir: str) -> Dict[str, dict]:

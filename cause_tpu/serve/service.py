@@ -52,8 +52,9 @@ from .. import serde
 from .. import sync
 from ..collections import shared as s
 from .controller import BatchController
-from .ingest import IngestJournal, IngestQueue
+from .ingest import IngestQueue
 from .residency import ResidencyManager
+from .wal import open_journal
 
 __all__ = ["ServiceCrashed", "SyncService"]
 
@@ -114,6 +115,18 @@ class SyncService:
         from ..parallel.session import FleetSession
 
         uuid = str(left.ct.uuid)
+        if uuid in self.tenants:
+            # the PR-13 foot-gun: evolve() KEEPS the uuid, so two
+            # tenants built from one ancestor collide here — and a
+            # silent overwrite cross-wires both tenants' journal
+            # watermarks and residency slots (it corrupted the first
+            # net soak run). Mint a fresh clist per tenant instead.
+            raise s.CausalError(
+                "serve: duplicate tenant uuid",
+                {"causes": {"duplicate-tenant"}, "uuid": uuid,
+                 "why": "evolve() keeps the uuid — a second tenant "
+                        "must start from a fresh clist, not an "
+                        "evolve() of an already-registered one"})
         sess = FleetSession([(left, right)], d_max=self.d_max)
         sess.wave()
         self.residency.insert(uuid, sess)
@@ -326,11 +339,18 @@ class SyncService:
             raise ValueError("no checkpoint dir configured")
         with obs.span("serve.checkpoint", tenants=len(self.tenants)):
             files = self.residency.checkpoint_all(out_dir)
+            # the minimum live watermark: every journal record at or
+            # below it is applied by its tenant AND captured by the
+            # packs just written — the WAL's GC retires segments
+            # wholly below it once the manifest rename lands
+            min_seq = min((t["applied_seq"]
+                           for t in self.tenants.values()), default=0)
             manifest = {
                 "~serve_manifest": MANIFEST_VERSION,
                 "ts_us": time.time_ns() // 1000,
                 "journal": (self.queue.journal.path
                             if self.queue.journal else None),
+                "gc_watermark": min_seq,
                 # the admission regime rides the manifest so a
                 # queue-less restore() rebuilds the SAME bounds — a
                 # restart must not quietly relax them
@@ -351,10 +371,74 @@ class SyncService:
             tmp = f"{path}.tmp.{os.getpid()}"
             with open(tmp, "w") as f:
                 f.write(json.dumps(manifest))
-            os.replace(tmp, path)
+            try:
+                if _chaos.enabled() \
+                        and _chaos.disk_rename_fail("serve.checkpoint"):
+                    raise OSError("chaos: injected rename failure")
+                os.replace(tmp, path)
+            except OSError as e:
+                # the atomic swap failed: the PREVIOUS manifest is
+                # untouched (that is the whole point of rename-last)
+                # and the journal still covers everything since it —
+                # evidence the fault, drop the orphan tmp, and let the
+                # caller retry the checkpoint
+                try:
+                    os.unlink(tmp)
+                except OSError:  # pragma: no cover - best-effort
+                    pass
+                if obs.enabled():
+                    obs.counter("serve.disk_faults").inc()
+                    obs.event("serve.disk", op="checkpoint",
+                              why="rename-failed", path=path)
+                raise s.CausalError(
+                    "serve: checkpoint manifest rename failed "
+                    "(previous manifest intact)",
+                    {"causes": {"checkpoint-rename"},
+                     "path": path}) from e
             if obs.enabled():
                 obs.counter("serve.checkpoints").inc()
+            self._storage_gc(out_dir, min_seq, manifest)
         return path
+
+    def _storage_gc(self, out_dir: str, min_seq: int,
+                    manifest: dict) -> None:
+        """Post-checkpoint retention, one policy for all three
+        storage surfaces: retire WAL segments wholly below the
+        manifest's watermark (crash-safe inside ``wal.gc``), sweep
+        superseded checkpoint packs + orphaned tmp files out of the
+        checkpoint dir, and sweep stale residency spill packs. Runs
+        only AFTER the manifest rename landed — everything removed is
+        re-derivable from the manifest + surviving journal suffix."""
+        j = self.queue.journal
+        wal_gc = None
+        if j is not None and hasattr(j, "gc"):
+            wal_gc = j.gc(min_seq)
+        live = {info["file"] for info in manifest["tenants"].values()}
+        live.add(MANIFEST_NAME)
+        swept = swept_bytes = 0
+        for name in os.listdir(out_dir):
+            if name in live:
+                continue
+            if not (name.endswith(".ckpt.json") or ".tmp." in name):
+                continue  # never touch files this service didn't write
+            fp = os.path.join(out_dir, name)
+            try:
+                nb = os.path.getsize(fp)
+                os.unlink(fp)
+            except OSError:
+                continue
+            swept += 1
+            swept_bytes += nb
+        spill_bytes = self.residency.sweep_spill()
+        if obs.enabled():
+            obs.event("serve.gc", watermark=min_seq,
+                      wal_retired=(wal_gc or {}).get("retired", 0),
+                      wal_retired_bytes=(wal_gc or {}).get(
+                          "retired_bytes", 0),
+                      wal_aborted=bool((wal_gc or {}).get("aborted")),
+                      packs_swept=swept,
+                      packs_swept_bytes=swept_bytes,
+                      spill_swept_bytes=spill_bytes)
 
     def drain(self, out_dir: Optional[str] = None) -> str:
         """Graceful drain: stop admission → flush the queue (deferred
@@ -440,7 +524,10 @@ class SyncService:
                 {"causes": {"checkpoint-mismatch"}})
         journal_path = manifest.get("journal")
         if queue is None:
-            journal = (IngestJournal(journal_path)
+            # open_journal routes a directory to the segmented WAL
+            # and a legacy single-file path to IngestJournal — old
+            # manifests restore unchanged
+            journal = (open_journal(journal_path)
                        if journal_path else None)
             qcfg = manifest.get("queue") or {}
             queue = IngestQueue(
@@ -490,7 +577,7 @@ class SyncService:
         if qj is not None and qj.path == journal_path:
             journal, borrowed = qj, True
         else:
-            journal, borrowed = IngestJournal(journal_path), False
+            journal, borrowed = open_journal(journal_path), False
         for e in journal.iter_from(min_seq):
             uuid = str(e.get("uuid"))
             t = self.tenants.get(uuid)
@@ -517,6 +604,16 @@ class SyncService:
         for uuid, batch in by_tenant.items():
             self._apply_batches(uuid, batch)
             ops += sum(x.ops for x in batch)
+        # torn/corrupt lines were COUNTED by the scan but invisible to
+        # the dashboard until PR 15: any skip on a replay is evidence
+        # (a torn tail is expected after a crash; CRC corruption never
+        # is — both deserve an alert, not a buried counter)
+        torn = int(getattr(journal, "skipped", 0) or 0)
+        rot = int(getattr(journal, "corrupt", 0) or 0)
+        if (torn or rot) and obs.enabled():
+            obs.counter("serve.journal_torn").inc(torn + rot)
+            obs.event("serve.journal_torn", skipped=torn, corrupt=rot,
+                      journal=journal_path)
         if not borrowed:
             journal.close()
         return ops

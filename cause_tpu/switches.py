@@ -42,6 +42,7 @@ KNOWN_ENV_KNOBS = (
     "CAUSE_TPU_LEDGER",
     "CAUSE_TPU_LAG_SLO_MS",
     "CAUSE_TPU_CHAOS",
+    "CAUSE_TPU_WAL_FSYNC",
 )
 
 # The XLA-only streaming candidate combination ("beststream"): the

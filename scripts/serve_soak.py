@@ -20,15 +20,44 @@ contracts machine-to-machine:
 - **p99 admitted-op lag** — create→converged over the PR-9 tracer;
   reported always, gated when ``--slo-ms`` is given (exit 3).
 
+The journal is PR 15's segmented CRC WAL (``serve/wal.py``):
+retired segments move to a retire dir, so the oracle replays the
+WHOLE admission history (retired + live segments) even after GC.
+``--chaos disk`` (exit 7 on any miss) arms a committed seeded plan
+(``--disk-plan``) covering all five disk fault modes plus a mid-GC
+crash, drives periodic checkpoints so the WAL GC actually cycles,
+and adds the storage gates:
+
+- **zero admitted-op loss across storage faults** — refused appends
+  (ENOSPC/torn) must surface as ``durability``-rung sheds with
+  ``retry_after_ms`` in EXACT injected counts (the producer re-offers;
+  nothing acked is lost), bit-rot must be found by the scrubber's CRC
+  walk in exact count (the intact ground truth rides the chaos
+  injection log back into the oracle), fsync failures and the
+  checkpoint-rename failure must each land their ``serve.disk``
+  evidence, and the previous manifest must stay intact;
+- **replay-after-GC bit-identity** — a restore AFTER the mid-GC crash
+  and an explicit end-of-run GC pass must reproduce every digest and
+  the exact record list above the watermark;
+- **bounded disk** — live WAL bytes sampled across >=3 checkpoint/GC
+  cycles stay bounded while the cumulative appended-bytes baseline
+  (what a single unrotated file would hold) grows monotonically;
+- **final scrub clean** — the faulty segments sealed, retired, and
+  out of the live WAL by the end of the run.
+
 A clean run lands a ``--kind serve`` ledger row (value = p99
 admitted-op lag ms; extra = p50/p99, sustained waves/sec, shed
-counts by rung, admitted totals, crash count + MTTR).
+counts by rung, admitted totals, crash count + MTTR) — or a
+``--kind disk`` row (value = live WAL bytes after the final GC;
+extra = the full storage-gate evidence) under ``--chaos disk``.
 
 Usage::
 
     python scripts/serve_soak.py --obs-out serve.jsonl \
         [--tenants 8] [--capacity 4] [--seconds 20] [--rate-mult 2] \
-        [--max-ops 256] [--seed 0] [--chaos] [--slo-ms 5000]
+        [--max-ops 256] [--seed 0] [--chaos [crash|disk]] \
+        [--fsync batch] [--disk-plan measurements/disk_plan_r15.json] \
+        [--slo-ms 5000]
 
 The generator is OPEN-LOOP: it offers per-site delta batches (zipf
 tenant pick, occasional no-sleep bursts) on its own clock and never
@@ -46,6 +75,7 @@ import argparse
 import json
 import os
 import random
+import shutil
 import sys
 import threading
 import time
@@ -61,16 +91,21 @@ from cause_tpu import chaos, obs, serde, sync  # noqa: E402
 from cause_tpu.collections import clist as c_list  # noqa: E402
 from cause_tpu.collections.clist import CausalList  # noqa: E402
 from cause_tpu.ids import new_site_id  # noqa: E402
+from cause_tpu.collections import shared as _shared  # noqa: E402
 from cause_tpu.obs import lag as _lag  # noqa: E402
-from cause_tpu.serve import (IngestJournal, IngestQueue,  # noqa: E402
-                             ResidencyManager, ServiceCrashed,
-                             SyncService)
+from cause_tpu.serve import (IngestQueue, ResidencyManager,  # noqa: E402
+                             ServiceCrashed, SyncService,
+                             WriteAheadLog)
+from cause_tpu.serve import wal as wal_mod  # noqa: E402
+from cause_tpu.serve.scrub import scrub_wal  # noqa: E402
+from cause_tpu.serve.service import MANIFEST_NAME  # noqa: E402
 
 # exit codes (soak.py's vocabulary, extended)
 EXIT_LAG = 3
 EXIT_CONVERGENCE = 4
 EXIT_UNEVIDENCED_SHED = 5
 EXIT_DEPTH = 6
+EXIT_DISK = 7
 
 
 class _SiteState:
@@ -199,28 +234,43 @@ def _pure(h):
     return CausalList(h.ct.evolve(weaver="pure", lanes=None))
 
 
-def _journal_oracle(pairs_init, journal_path):
+def _wal_entries(wal_dir, retired_dir):
+    """Every admitted record the storage layer ever held, seq-sorted:
+    live PLUS retired segments (GC MOVES sealed segments into the
+    retire dir, so the union is the whole admission history), with
+    bit-rotted records' intact ground truth read back from the chaos
+    injection log — the durable copy is wrong ON PURPOSE; the oracle
+    replays what was acknowledged, not what the rot left behind."""
+    entries = {}
+    for d in (retired_dir, wal_dir):
+        if not d or not os.path.isdir(d):
+            continue
+        for name in sorted(os.listdir(d)):
+            if not (name.startswith("wal-") and name.endswith(".seg")):
+                continue
+            for kind, e in wal_mod.scan_segment_file(
+                    os.path.join(d, name)):
+                if kind in ("rec", "legacy") and isinstance(e, dict) \
+                        and "seq" in e:
+                    entries[int(e["seq"])] = e
+    for r in chaos.injected():
+        if r.get("family") == "disk" and r.get("mode") == "bitrot" \
+                and isinstance(r.get("rec"), dict):
+            rec = r["rec"]
+            entries[int(rec["seq"])] = rec
+    return [entries[k] for k in sorted(entries)]
+
+
+def _journal_oracle(pairs_init, wal_dir, retired_dir):
     """The independent no-loss oracle: each tenant's initial PURE
-    pair merge, plus a pure replay of EVERY journal entry (the
-    write-ahead journal is the authoritative record of admission) —
+    pair merge, plus a pure replay of EVERY journaled entry (the
+    write-ahead log is the authoritative record of admission) —
     computed with chaos suspended and obs off so the replay neither
     consumes fault counters nor pollutes the lag stream."""
     out = {}
     for uuid, (a, b) in pairs_init.items():
         out[uuid] = _pure(a).merge(_pure(b))
-    entries = []
-    if journal_path and os.path.exists(journal_path):
-        for line in open(journal_path, encoding="utf-8"):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                e = json.loads(line)
-            except ValueError:
-                continue
-            if isinstance(e, dict) and "seq" in e:
-                entries.append(e)
-    entries.sort(key=lambda e: int(e["seq"]))
+    entries = _wal_entries(wal_dir, retired_dir)
     for e in entries:
         uuid = str(e.get("uuid"))
         if uuid not in out:
@@ -241,28 +291,26 @@ def _doc_equal(dev_handle, pure_handle) -> bool:
             == [n[0] for n in pure_handle.get_weave()])
 
 
-def _restart(svc, ckpt_dir, capacity, d_max, watchdog_s):
+def _restart(svc, ckpt_dir, capacity, d_max, watchdog_s, mk_journal):
     """The crash protocol: close the old incarnation's front door and
     journal handle, drop EVERY in-memory structure, restore from the
     last checkpoint + journal (same admission bound, same residency
     pressure, same window budget, same measured controller floor — a
     restart must not quietly relax the memory, admission or control
-    regime)."""
+    regime). ``mk_journal`` reopens the SAME WAL directory with the
+    same rotation/fsync/retire policy — a restart must not quietly
+    relax the durability regime either."""
     from cause_tpu.serve import BatchController
 
     floor_ms = svc.controller.floor_ms
     t_batch_ms = svc.controller.t_batch_ms
     max_ops = svc.queue.max_ops
-    journal_path = (svc.queue.journal.path
-                    if svc.queue.journal else None)
     svc.close()  # watchdog + the incarnation's live obs subscriber
     svc.queue.close_admission()
     if svc.queue.journal is not None:
         svc.queue.journal.close()
     del svc
-    queue = IngestQueue(
-        max_ops=max_ops,
-        journal=IngestJournal(journal_path) if journal_path else None)
+    queue = IngestQueue(max_ops=max_ops, journal=mk_journal())
     return SyncService.restore(
         ckpt_dir, queue=queue,
         residency=ResidencyManager(capacity=capacity),
@@ -287,12 +335,32 @@ def main():
     ap.add_argument("--d-max", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--calib-ticks", type=int, default=4)
-    ap.add_argument("--chaos", action="store_true",
-                    help="arm seeded crash points: one mid-steady-"
-                         "state serve.tick crash and one mid-drain "
-                         "serve.drain crash; the harness restores "
-                         "from checkpoint + journal and the no-loss "
-                         "gates must still hold")
+    ap.add_argument("--chaos", nargs="?", const="crash", default=None,
+                    choices=("crash", "disk"),
+                    help="arm a seeded fault arm: 'crash' (bare "
+                         "--chaos keeps meaning this) arms one mid-"
+                         "steady-state serve.tick crash and one mid-"
+                         "drain serve.drain crash; 'disk' arms the "
+                         "committed --disk-plan (all five disk fault "
+                         "modes + a mid-GC crash) and the storage "
+                         "gates (exit 7). Either way the harness "
+                         "restores from checkpoint + journal and the "
+                         "no-loss gates must still hold")
+    ap.add_argument("--fsync", default="batch",
+                    choices=("none", "batch", "always"),
+                    help="WAL fsync policy (PERF.md Round 15 prices "
+                         "the three)")
+    ap.add_argument("--disk-plan",
+                    default=os.path.join(
+                        os.path.dirname(os.path.abspath(__file__)),
+                        "..", "measurements", "disk_plan_r15.json"),
+                    help="seeded chaos plan JSON for --chaos disk "
+                         "(the COMMITTED plan is the reproducible "
+                         "acceptance artifact)")
+    ap.add_argument("--rotate-bytes", type=int, default=None,
+                    help="WAL segment rotation threshold (default "
+                         "8 KiB under --chaos disk so GC cycles "
+                         "several times per run, 512 KiB otherwise)")
     ap.add_argument("--obs-out", required=True,
                     help="obs JSONL sidecar (required: the committed "
                          "stream IS the shed/lag/crash evidence)")
@@ -303,6 +371,11 @@ def main():
                          "tempdir next to --obs-out)")
     args = ap.parse_args()
 
+    # the sidecar IS the run's evidence: the gates compare engine
+    # stats against THIS run's events, so a stale file from an
+    # earlier run must not pollute the counts
+    if os.path.exists(args.obs_out):
+        os.unlink(args.obs_out)
     obs.configure(enabled=True, out=args.obs_out)
     obs.set_platform(jax.default_backend())
     sync.quarantine_reset()
@@ -310,13 +383,26 @@ def main():
     state_dir = args.state_dir or (args.obs_out + ".state")
     ckpt_dir = os.path.join(state_dir, "ckpt")
     os.makedirs(ckpt_dir, exist_ok=True)
-    journal_path = os.path.join(state_dir, "ingest.jsonl")
-    if os.path.exists(journal_path):
-        os.unlink(journal_path)
+    wal_dir = os.path.join(state_dir, "wal")
+    retired_dir = os.path.join(state_dir, "wal_retired")
+    for d in (wal_dir, retired_dir):
+        if os.path.isdir(d):
+            shutil.rmtree(d)
+    legacy_journal = os.path.join(state_dir, "ingest.jsonl")
+    if os.path.exists(legacy_journal):
+        os.unlink(legacy_journal)
+    rotate_bytes = args.rotate_bytes or (
+        8192 if args.chaos == "disk" else 512 * 1024)
+
+    def _mk_journal():
+        # the PR-15 segmented WAL: same policy on every incarnation;
+        # GC retires sealed segments INTO retired_dir so the oracle
+        # can replay the whole admission history after GC
+        return WriteAheadLog(wal_dir, rotate_bytes=rotate_bytes,
+                             fsync=args.fsync, retire_dir=retired_dir)
 
     capacity = args.capacity or max(1, args.tenants // 2)
-    queue = IngestQueue(max_ops=args.max_ops,
-                        journal=IngestJournal(journal_path))
+    queue = IngestQueue(max_ops=args.max_ops, journal=_mk_journal())
     svc = SyncService(queue,
                       residency=ResidencyManager(capacity=capacity),
                       checkpoint_dir=ckpt_dir, d_max=args.d_max,
@@ -409,6 +495,19 @@ def main():
     run_epoch = _lag.current_epoch()
     svc.checkpoint()  # the durable baseline every crash restores past
 
+    if args.chaos == "disk":
+        # arm AFTER calibration + the baseline checkpoint so the
+        # plan's per-hook invocation indices count from the run's
+        # first real append — the committed plan is reproducible
+        with open(args.disk_plan) as f:
+            disk_plan = json.load(f)
+        chaos.configure(plan=disk_plan)
+        print(f"serve soak: disk chaos armed from {args.disk_plan} "
+              f"(seed {disk_plan.get('seed')}, "
+              f"{len(disk_plan.get('faults') or [])} fault spec(s); "
+              f"fsync={args.fsync} rotate_bytes={rotate_bytes})",
+              flush=True)
+
     gen = Generator(holder, tenants, offered_per_s, args.seed)
     t_run_start_us = time.time_ns() // 1000
     gen.start()
@@ -421,8 +520,23 @@ def main():
     crashes = 0
     mttr_ms = []
     chaos_armed = False
+    # --chaos disk: periodic checkpoints drive the retention policy —
+    # each one advances the watermark and the WAL GC retires the
+    # fully-applied segments; the bounded-disk gate samples across
+    # these cycles while baseline_accum carries the would-have-been
+    # single-file size (lifetime appended bytes) across restarts
+    ckpt_every = max(1.0, args.seconds / 8.0)
+    next_ckpt = t_start + ckpt_every
+    gc_cycles = 0
+    gc_crashes = 0
+    rename_survived = 0
+    manifest_intact = True
+    baseline_accum = 0
+    live_bytes_series = []
+    baseline_bytes_series = []
+    manifest_path = os.path.join(ckpt_dir, MANIFEST_NAME)
     while time.perf_counter() < deadline:
-        if args.chaos and not chaos_armed \
+        if args.chaos == "crash" and not chaos_armed \
                 and time.perf_counter() - t_start > args.seconds / 2:
             # arm at the wall-clock midpoint: the NEXT tick crashes
             # (mid-steady-state) and the FIRST drain invocation
@@ -441,8 +555,9 @@ def main():
             print(f"serve soak: CRASH ({e}) — restoring", flush=True)
             t_crash = time.perf_counter()
             retired_queues.append(svc.queue)
+            baseline_accum += svc.queue.journal.appended_bytes
             svc = _restart(svc, ckpt_dir, capacity, args.d_max,
-                           watchdog_s=5.0)
+                           5.0, _mk_journal)
             holder["queue"] = svc.queue
             svc.start_watchdog()
             svc.tick()  # the first post-restore tick closes the MTTR
@@ -450,6 +565,53 @@ def main():
             crashes += 1
             mttr_ms.append(round(1000 * (time.perf_counter()
                                          - t_crash), 3))
+        if args.chaos == "disk" and time.perf_counter() >= next_ckpt:
+            try:
+                svc.checkpoint()
+                gc_cycles += 1
+            except ServiceCrashed as e:
+                # the seeded mid-GC crash: the watermark manifest
+                # landed, the retired-but-not-yet-moved segments are
+                # still on disk — the restore must replay identically
+                # and the NEXT cycle's GC finishes the retirement
+                print(f"serve soak: CRASH mid-GC ({e}) — restoring",
+                      flush=True)
+                t_crash = time.perf_counter()
+                retired_queues.append(svc.queue)
+                baseline_accum += svc.queue.journal.appended_bytes
+                svc = _restart(svc, ckpt_dir, capacity, args.d_max,
+                               5.0, _mk_journal)
+                holder["queue"] = svc.queue
+                svc.start_watchdog()
+                crashes += 1
+                gc_crashes += 1
+                mttr_ms.append(round(1000 * (time.perf_counter()
+                                             - t_crash), 3))
+            except _shared.CausalError as e:
+                causes = getattr(e, "info", {}).get("causes", ())
+                if "checkpoint-rename" not in causes:
+                    raise
+                # the injected manifest-rename failure: the PREVIOUS
+                # manifest must still parse — the service keeps
+                # serving and the next cycle's checkpoint supersedes
+                try:
+                    with open(manifest_path) as f:
+                        m = json.load(f)
+                    ok = (isinstance(m, dict)
+                          and "~serve_manifest" in m)
+                except (OSError, ValueError):
+                    ok = False
+                manifest_intact = manifest_intact and ok
+                rename_survived += 1
+                print("serve soak: checkpoint rename failed "
+                      f"(previous manifest intact: {ok})", flush=True)
+            live_bytes_series.append(svc.queue.journal.dir_bytes())
+            baseline_bytes_series.append(
+                baseline_accum + svc.queue.journal.appended_bytes)
+            # re-space from NOW (not += ckpt_every): a slow restore
+            # must not make missed slots fire back-to-back — each
+            # bounded-disk sample prices a real interval of appends
+            next_ckpt = time.perf_counter() + ckpt_every
         if svc.queue.depth == 0:
             # T_batch is a coalescing window, not a pure delay: with
             # a backlog waiting the batch is already built — tick on
@@ -462,21 +624,51 @@ def main():
               + "; ".join(holder["gen_errors"]), flush=True)
         return 2
 
-    # ---- drain (chaos: crashes once mid-drain, restored, re-drained)
-    try:
-        svc.drain()
-    except ServiceCrashed as e:
-        print(f"serve soak: CRASH mid-drain ({e}) — restoring",
+    # ---- drain (chaos: crashes once mid-drain, restored, re-drained;
+    # disk: the drain-time checkpoint may hit the injected manifest-
+    # rename failure — the previous manifest is intact by contract
+    # and the drain is simply retried, exactly a real operator's move)
+    for _ in range(4):
+        try:
+            svc.drain()
+            break
+        except ServiceCrashed as e:
+            print(f"serve soak: CRASH mid-drain ({e}) — restoring",
+                  flush=True)
+            t_crash = time.perf_counter()
+            retired_queues.append(svc.queue)
+            baseline_accum += svc.queue.journal.appended_bytes
+            svc = _restart(svc, ckpt_dir, capacity, args.d_max,
+                           None, _mk_journal)
+            holder["queue"] = svc.queue
+            crashes += 1
+            mttr_ms.append(round(1000 * (time.perf_counter()
+                                         - t_crash), 3))
+        except _shared.CausalError as e:
+            causes = getattr(e, "info", {}).get("causes", ())
+            if "checkpoint-rename" not in causes:
+                raise
+            try:
+                with open(manifest_path) as f:
+                    m = json.load(f)
+                ok = isinstance(m, dict) and "~serve_manifest" in m
+            except (OSError, ValueError):
+                ok = False
+            manifest_intact = manifest_intact and ok
+            rename_survived += 1
+            print("serve soak: drain checkpoint rename failed "
+                  f"(previous manifest intact: {ok}) — retrying",
+                  flush=True)
+    else:
+        print("serve soak: drain did not complete in 4 attempts",
               flush=True)
-        t_crash = time.perf_counter()
-        retired_queues.append(svc.queue)
-        svc = _restart(svc, ckpt_dir, capacity, args.d_max,
-                       watchdog_s=None)
-        holder["queue"] = svc.queue
-        crashes += 1
-        mttr_ms.append(round(1000 * (time.perf_counter() - t_crash),
-                             3))
-        svc.drain()
+        return EXIT_CONVERGENCE
+    if args.chaos == "disk":
+        # the drain checkpoint is the run's last GC cycle — sample it
+        gc_cycles += 1
+        live_bytes_series.append(svc.queue.journal.dir_bytes())
+        baseline_bytes_series.append(
+            baseline_accum + svc.queue.journal.appended_bytes)
     digests = {u: svc.converged_digest(u) for u in pairs_init}
     t_batch_final = round(svc.controller.t_batch_ms, 3)
     control_changes = svc.controller.changes
@@ -495,11 +687,39 @@ def main():
     obs.flush()
     with chaos.suspended():
         obs.configure(enabled=False)
-        oracle, journal_entries = _journal_oracle(pairs_init,
-                                                  journal_path)
+        oracle, journal_entries = _journal_oracle(pairs_init, wal_dir,
+                                                  retired_dir)
         mismatched = [u for u in pairs_init
                       if not _doc_equal(svc2.materialize(u),
                                         oracle[u])]
+    # (2b) disk arm: replay-after-GC bit-identity + the final scrub —
+    # an explicit end-of-run GC pass at the manifest watermark must
+    # not change the replayable suffix, a THIRD restore after it must
+    # reproduce every digest, and the live WAL must scrub clean (the
+    # faulty segments sealed + retired during the run)
+    replay_after_gc_ok = True
+    gc_restore_ok = True
+    final_live_bytes = None
+    scrub_rep = None
+    if args.chaos == "disk":
+        with chaos.suspended():
+            with open(manifest_path) as f:
+                final_wm = int(json.load(f).get("gc_watermark") or 0)
+            svc2.queue.journal.close()
+            jx = _mk_journal()
+            pre_gc = list(jx.iter_from(final_wm))
+            jx.gc(final_wm)
+            post_gc = list(jx.iter_from(final_wm))
+            replay_after_gc_ok = pre_gc == post_gc
+            svc3 = SyncService.restore(
+                ckpt_dir,
+                residency=ResidencyManager(capacity=capacity),
+                d_max=args.d_max)
+            gc_restore_ok = all(svc3.converged_digest(u) == digests[u]
+                                for u in pairs_init)
+            final_live_bytes = jx.dir_bytes()
+            jx.close()
+            scrub_rep = scrub_wal(wal_dir, retired=retired_dir)
     # (3) evidence + bounds, over the committed sidecar
     from cause_tpu.obs import lag as lag_mod
     from cause_tpu.obs import ledger
@@ -510,7 +730,8 @@ def main():
                    and e.get("name") == "serve.shed"]
     stats_total = {"sheds": 0, "shed_ops": 0, "admitted_ops": 0,
                    "admitted_batches": 0, "max_depth": 0}
-    by_rung = {"defer": 0, "reject": 0, "drop_oldest": 0}
+    by_rung = {"defer": 0, "reject": 0, "drop_oldest": 0,
+               "durability": 0}
     for q in retired_queues:
         for k in ("sheds", "shed_ops", "admitted_ops",
                   "admitted_batches"):
@@ -530,6 +751,101 @@ def main():
     waves_per_s = round(waves / max(1e-3, elapsed), 2)
     chaos_injects = sum(1 for e in evs if e.get("ev") == "event"
                         and e.get("name") == "chaos.inject")
+
+    # ---- disk-arm detection + bounded-disk evidence -----------------
+    # every INJECTED storage fault must be DETECTED with exact
+    # evidence on the right ladder: refused appends as durability
+    # sheds, bit-rot by the scrubber's CRC walk, fsync/rename
+    # failures as serve.disk events, the mid-GC crash survived
+    disk_summary = None
+    disk_failures = []
+    if args.chaos == "disk":
+        inj_by_mode = {}
+        inj_gc_crashes = 0
+        for r in chaos.injected():
+            if r.get("family") == "disk":
+                m = r.get("mode")
+                inj_by_mode[m] = inj_by_mode.get(m, 0) + 1
+            elif r.get("family") == "crash" \
+                    and r.get("site") == "serve.wal.gc":
+                inj_gc_crashes += 1
+        shed_reasons = {}
+        for e in shed_events:
+            f = e.get("fields") or {}
+            if f.get("rung") == "durability":
+                shed_reasons[f.get("reason")] = \
+                    shed_reasons.get(f.get("reason"), 0) + 1
+        disk_ops = {}
+        for e in evs:
+            if e.get("ev") == "event" and e.get("name") == "serve.disk":
+                op = (e.get("fields") or {}).get("op")
+                disk_ops[op] = disk_ops.get(op, 0) + 1
+        retired_rep = (scrub_rep or {}).get("retired") or {}
+        crc_found = ((scrub_rep or {}).get("crc_failures", 0)
+                     + retired_rep.get("crc_failures", 0))
+        torn_found = ((scrub_rep or {}).get("torn", 0)
+                      + retired_rep.get("torn", 0))
+        checks = {
+            "enospc_refused_exactly":
+                inj_by_mode.get("enospc", 0) > 0
+                and shed_reasons.get("wal-enospc", 0)
+                == inj_by_mode["enospc"],
+            "torn_refused_exactly":
+                inj_by_mode.get("torn", 0) > 0
+                and shed_reasons.get("wal-torn", 0)
+                == inj_by_mode["torn"]
+                and torn_found == inj_by_mode["torn"],
+            "bitrot_scrubbed_exactly":
+                inj_by_mode.get("bitrot", 0) > 0
+                and crc_found == inj_by_mode["bitrot"],
+            "fsync_fail_evidenced":
+                inj_by_mode.get("fsync", 0) > 0
+                and disk_ops.get("fsync", 0) == inj_by_mode["fsync"],
+            "rename_fail_evidenced":
+                inj_by_mode.get("rename", 0) > 0
+                and disk_ops.get("checkpoint", 0)
+                == inj_by_mode["rename"],
+            "manifest_intact": manifest_intact and rename_survived > 0,
+            "gc_crash_survived": gc_crashes >= 1
+                and inj_gc_crashes >= 1,
+            "replay_after_gc_identical": replay_after_gc_ok
+                and gc_restore_ok,
+            "live_scrub_clean": bool((scrub_rep or {}).get("clean")),
+            # Baseline must grow strictly while the generator runs
+            # (appends never starved); the final drain-time sample may
+            # tie — generation has already stopped by then.
+            "disk_bounded": gc_cycles >= 3
+                and len(live_bytes_series) >= 3
+                and all(b2 > b1 for b1, b2 in zip(
+                    baseline_bytes_series[:-1],
+                    baseline_bytes_series[1:-1]))
+                and baseline_bytes_series[-1]
+                >= baseline_bytes_series[-2]
+                and live_bytes_series[-1] * 2
+                < baseline_bytes_series[-1],
+        }
+        disk_failures = sorted(k for k, ok in checks.items() if not ok)
+        disk_summary = {
+            "fsync": args.fsync, "rotate_bytes": rotate_bytes,
+            "plan": os.path.relpath(args.disk_plan),
+            "injected_by_mode": inj_by_mode,
+            "gc_crashes_injected": inj_gc_crashes,
+            "durability_sheds_by_reason": shed_reasons,
+            "serve_disk_events_by_op": disk_ops,
+            "gc_cycles": gc_cycles, "gc_crashes": gc_crashes,
+            "rename_survived": rename_survived,
+            "live_bytes_series": live_bytes_series,
+            "baseline_bytes_series": baseline_bytes_series,
+            "final_live_bytes": final_live_bytes,
+            "scrub": {"clean": bool((scrub_rep or {}).get("clean")),
+                      "crc_failures": crc_found,
+                      "torn": torn_found,
+                      "live_segments":
+                          len((scrub_rep or {}).get("segments") or []),
+                      "retired_segments":
+                          len(retired_rep.get("segments") or [])},
+            "checks": checks,
+        }
 
     summary = {
         "rate_mult": args.rate_mult,
@@ -552,9 +868,12 @@ def main():
         "floor_ms": round(floor_ms, 3),
         "crashes": crashes, "mttr_ms": mttr_ms,
         "chaos_injects": chaos_injects,
+        "fsync": args.fsync,
         "restore_bit_identical": bool(restore_ok),
         "oracle_mismatches": mismatched,
     }
+    if disk_summary is not None:
+        summary["disk"] = disk_summary
     print("serve soak:", json.dumps(summary, indent=1), flush=True)
 
     if stats_total["max_depth"] > args.max_ops:
@@ -570,31 +889,44 @@ def main():
               f"(restore_ok={restore_ok}, mismatched={mismatched})",
               flush=True)
         return EXIT_CONVERGENCE
-    if args.chaos and crashes < 2:
+    if args.chaos == "crash" and crashes < 2:
         print(f"serve soak: chaos armed but only {crashes} crash(es) "
               "fired — the no-loss claim was not exercised",
               flush=True)
         return EXIT_CONVERGENCE
+    if args.chaos == "disk" and disk_failures:
+        print(f"serve soak: DISK GATES FAILED: {disk_failures}",
+              flush=True)
+        return EXIT_DISK
 
     try:
+        if args.chaos == "disk":
+            row_kind = "disk"
+            metric = "disk soak live WAL bytes after final GC"
+            value = final_live_bytes
+        else:
+            row_kind = "serve"
+            metric = "serve soak p99 admitted-op lag"
+            value = conv["p99_ms"]
         row = ledger.ingest_record(
             {
                 "platform": jax.default_backend(),
-                "metric": "serve soak p99 admitted-op lag",
-                "value": conv["p99_ms"],
-                "kernel": "serve",
+                "metric": metric,
+                "value": value,
+                "kernel": row_kind,
                 "config": f"tenants={args.tenants} cap={capacity} "
                           f"mult={args.rate_mult:g} "
                           f"max_ops={args.max_ops} "
-                          f"chaos={int(args.chaos)}",
+                          f"chaos={args.chaos or 'off'} "
+                          f"fsync={args.fsync}",
                 "smoke": False,
             },
             source=f"serve-soak seed={args.seed} "
                    f"seconds={args.seconds:g}",
             obs_jsonl=args.obs_out,
-            kind="serve",
-            extra={"serve": {k: v for k, v in summary.items()
-                             if k != "oracle_mismatches"}},
+            kind=row_kind,
+            extra={row_kind: {k: v for k, v in summary.items()
+                              if k != "oracle_mismatches"}},
         )
         print(f"serve soak: ledger row ({row['platform']}) -> "
               f"{ledger.default_path()}", flush=True)

@@ -1,0 +1,75 @@
+"""Known-bad DSK001 fixture: durable-storage APIs on a traced path.
+Only the unguarded calls gate — every OBS003-007/CHS001/SRV001/NET001
+guard spelling (nested if, aliased import, early return, negated-test
+else) is sanctioned here too, and generic verbs (``log.append``/
+``x.gc``) on non-WAL objects must never be flagged. The imports spell
+the WAL module WITHOUT its ``serve`` parent qualifier on purpose: the
+DSK001 findings here must be DSK001's alone, not SRV001 shadows."""
+
+import jax
+
+from cause_tpu.serve import wal
+from cause_tpu.serve import wal as _wal
+from cause_tpu import obs
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    wal.open_journal("/tmp/wal")                     # DSK001: unguarded
+    if obs.enabled():
+        w = wal.WriteAheadLog("/tmp/wal")            # guarded: fine
+        w.append("u", "s", [])
+    if _obs_enabled():
+        # the aliased module spelling is fine under the aliased guard
+        _wal.WriteAheadLog("/tmp/wal")
+    return x * 2
+
+
+@jax.jit
+def traced_bare_name(x):
+    # distinctive bare names gate without a module qualifier too
+    from cause_tpu.serve.scrub import scrub_wal
+
+    scrub_wal("/tmp/wal")                            # DSK001: unguarded
+    return x + 1
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    wal.WriteAheadLog("/tmp/wal")
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful storage call), its ELSE branch is
+    # obs-on only (guarded: fine)
+    if not obs.enabled():
+        wal.open_journal("/tmp/wal")                 # DSK001
+    else:
+        wal.open_journal("/tmp/wal")                 # fine
+    return x
+
+
+class _NotWal:
+    def append(self, *a):
+        return a
+
+    def gc(self, n):
+        return n
+
+
+@jax.jit
+def traced_generic_verbs_ok(x):
+    # append()/gc() on an arbitrary object are NOT WAL APIs — the
+    # rule matches the wal/scrub module qualifiers or distinctive
+    # names only
+    log = _NotWal()
+    log.append(1)
+    log.gc(0)
+    return x

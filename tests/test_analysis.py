@@ -223,6 +223,26 @@ def test_net_unguarded_call_on_traced_path():
     assert rules_of(res) == ["NET001"]
 
 
+def test_wal_unguarded_call_on_traced_path():
+    """DSK001 (PR-15): the durable-storage layer fsyncs descriptors,
+    rotates/retires segment files and walks segment directories
+    re-checking CRCs — host storage work that must never sit on a
+    traced path unguarded. Exactly three findings — the plain
+    unguarded module-qualified call, a distinctive bare name, and the
+    body of a negated test; every OBS003-007/CHS001/SRV001/NET001
+    guard spelling is sanctioned, and generic verbs (append/gc) on
+    non-WAL objects never flag. The fixture spells the module without
+    its ``serve`` parent qualifier, so the findings are DSK001's
+    alone — no SRV001 shadows."""
+    res = run_api(os.path.join(FIX, "wal_caller_bad.py"))
+    dsk = [f for f in res.findings if f.rule == "DSK001"]
+    assert len(dsk) == 3, [f.message for f in dsk]
+    assert "wal.open_journal" in dsk[0].message
+    assert "scrub_wal" in dsk[1].message
+    assert "wal.open_journal" in dsk[2].message
+    assert rules_of(res) == ["DSK001"]
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -339,7 +359,7 @@ def test_cli_exit_codes():
     "semantic_caller_bad.py", "costmodel_caller_bad.py",
     "lag_caller_bad.py", "live_caller_bad.py",
     "chaos_caller_bad.py", "serve_caller_bad.py", "net_caller_bad.py",
-    "lca_bad.py",
+    "wal_caller_bad.py", "lca_bad.py",
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -351,7 +371,7 @@ def test_cli_list_rules():
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
                 "OBS006", "OBS007", "CHS001", "SRV001", "NET001",
-                "LCA001", "GEN001"):
+                "DSK001", "LCA001", "GEN001"):
         assert rid in out.stdout
 
 

@@ -767,3 +767,220 @@ def test_watch_renders_serve_line():
     assert "queue depth 5" in text
     prom = watch.prometheus_text(mon.snapshot(now_us=2_000_000))
     assert "cause_tpu_live_serve_queue_depth 5" in prom
+
+
+# ---------------------------------------------- PR 15: durable storage
+
+
+def _wal_service(tmp_path, rotate_bytes=220, **kw):
+    """A service over the segmented WAL instead of the single-file
+    journal — tiny segments so rotation/GC happen inside a test."""
+    from cause_tpu.serve import WriteAheadLog
+
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=rotate_bytes,
+                      fsync="none")
+    q = IngestQueue(max_ops=4096, journal=w)
+    return SyncService(
+        q, residency=ResidencyManager(capacity=4),
+        checkpoint_dir=str(tmp_path / "ckpt"), d_max=16, **kw)
+
+
+def test_duplicate_tenant_uuid_rejected(tmp_path):
+    """The PR-13 foot-gun, now a loud refusal: evolve() keeps the
+    uuid, so registering a second tenant built from an evolve() of an
+    already-registered document must raise — a silent overwrite
+    cross-wired both tenants' watermarks in the first net soak run."""
+    svc = _service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    a2, b2 = _pair(base)  # same ancestor -> same doc uuid
+    assert str(a2.ct.uuid) == uuid
+    with pytest.raises(s.CausalError) as ei:
+        svc.add_tenant(a2, b2)
+    assert "duplicate-tenant" in ei.value.info["causes"]
+    assert ei.value.info["uuid"] == uuid
+    # the original tenant is untouched
+    assert list(svc.tenants) == [uuid]
+    assert svc.residency.get(uuid) is not None
+
+
+def test_replay_with_torn_lines_emits_journal_torn_event(tmp_path):
+    obs.configure(enabled=True)
+    svc = _service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    left, right = svc.residency.get(uuid).pairs[0]
+    l2 = left.conj("x1")
+    svc.queue.offer(uuid, l2.ct.site_id, _delta_items(l2, left))
+    manifest = svc.drain()
+    # tear the journal tail the way a crash does: half a line
+    with open(svc.queue.journal.path, "a") as f:
+        f.write('{"seq": 99, "uuid": "' )
+    svc2 = SyncService.restore(manifest)
+    torn = _events("serve.journal_torn")
+    assert len(torn) == 1
+    assert torn[0]["fields"]["skipped"] == 1
+    assert torn[0]["fields"]["corrupt"] == 0
+    # ...and the live default rules page on it
+    from cause_tpu.obs import live
+
+    fold = live.LiveFold()
+    fold.feed_many(obs.events())
+    assert fold.snapshot()["serve"]["journal_torn"] == 1
+    svc2.close()
+
+
+def test_restore_watermark_inside_retired_segment(tmp_path):
+    """After a checkpoint + GC, the watermark points INTO territory
+    whose segments are gone — restore must replay only the live
+    suffix and still converge bit-identically."""
+    svc = _wal_service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    left, right = svc.residency.get(uuid).pairs[0]
+    cur = left
+    for i in range(6):  # enough appends to seal several segments
+        nxt = cur.conj(f"x{i}")
+        adm = svc.queue.offer(uuid, nxt.ct.site_id,
+                              _delta_items(nxt, cur))
+        assert adm.admitted
+        svc.tick()
+        cur = nxt
+    svc.checkpoint()  # watermark = applied seq; GC retires below it
+    assert svc.queue.journal.stats["gc_segments"] >= 1
+    # post-checkpoint ops land in live segments only
+    nxt = cur.conj("tail")
+    svc.queue.offer(uuid, nxt.ct.site_id, _delta_items(nxt, cur))
+    svc.tick()
+    edn0 = c.causal_to_edn(svc.materialize(uuid))
+    manifest = svc.drain()
+    svc2 = SyncService.restore(manifest)
+    assert c.causal_to_edn(svc2.materialize(uuid)) == edn0
+    svc2.close()
+
+
+def test_restore_watermark_spanning_segment_boundary(tmp_path):
+    """Crash with the watermark mid-history: replay starts inside one
+    segment and crosses into the next — the iter_from contract across
+    the rotation seam."""
+    obs.configure(enabled=True)
+    svc = _wal_service(tmp_path, rotate_bytes=150)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    left, right = svc.residency.get(uuid).pairs[0]
+    cur = left
+    # two applied ops, checkpoint (watermark=2), then four more
+    # admitted-but-unapplied ops spread over several tiny segments
+    for i in range(2):
+        nxt = cur.conj(f"a{i}")
+        svc.queue.offer(uuid, nxt.ct.site_id, _delta_items(nxt, cur))
+        svc.tick()
+        cur = nxt
+    svc.checkpoint()
+    for i in range(4):
+        nxt = cur.conj(f"b{i}")
+        assert svc.queue.offer(uuid, nxt.ct.site_id,
+                               _delta_items(nxt, cur)).admitted
+        cur = nxt
+    assert svc.queue.journal.stats["rotations"] >= 2
+    del svc  # crash: queue contents + sessions gone
+    svc2 = SyncService.restore(str(tmp_path / "ckpt"))
+    restored = _events("serve.restored")
+    assert restored and restored[-1]["fields"]["replayed"] == 4
+    oracle = CausalList(cur.ct.evolve(weaver="pure", lanes=None)).merge(
+        CausalList(right.ct.evolve(weaver="pure", lanes=None)))
+    assert c.causal_to_edn(svc2.materialize(uuid)) \
+        == c.causal_to_edn(oracle)
+    svc2.close()
+
+
+def test_gc_then_restore_replays_only_live_suffix(tmp_path):
+    """A GC'd-then-restored service replays ONLY the live suffix —
+    the retired records are inside the packs, and the restored state
+    is digest-identical to the pre-restart service."""
+    obs.configure(enabled=True)
+    svc = _wal_service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    left, right = svc.residency.get(uuid).pairs[0]
+    cur = left
+    for i in range(6):
+        nxt = cur.conj(f"x{i}")
+        svc.queue.offer(uuid, nxt.ct.site_id, _delta_items(nxt, cur))
+        svc.tick()
+        cur = nxt
+    manifest = svc.drain()  # checkpoint + GC: all segments retire
+    wal_stats = dict(svc.queue.journal.stats)
+    assert wal_stats["gc_segments"] >= 1
+    d0 = svc.converged_digest(uuid)
+    svc2 = SyncService.restore(manifest)
+    assert svc2.converged_digest(uuid) == d0
+    restored = _events("serve.restored")
+    # everything at/below the watermark is in the packs, not replayed
+    assert restored[-1]["fields"]["replayed"] == 0
+    svc2.close()
+
+
+def test_checkpoint_rename_failure_keeps_previous_manifest(tmp_path):
+    obs.configure(enabled=True)
+    svc = _wal_service(tmp_path)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    path = svc.checkpoint()
+    before = open(path).read()
+    left, right = svc.residency.get(uuid).pairs[0]
+    l2 = left.conj("x1")
+    svc.queue.offer(uuid, l2.ct.site_id, _delta_items(l2, left))
+    svc.tick()
+    chaos.configure(plan={"seed": 5, "faults": [
+        {"family": "disk", "site": "serve.checkpoint",
+         "mode": "rename", "at": [1]}]})
+    with pytest.raises(s.CausalError) as ei:
+        svc.checkpoint()
+    assert "checkpoint-rename" in ei.value.info["causes"]
+    # the previous manifest is byte-identical and restorable
+    assert open(path).read() == before
+    disks = [e for e in _events("serve.disk")
+             if e["fields"]["op"] == "checkpoint"]
+    assert len(disks) == 1
+    # next cycle (fault exhausted): the checkpoint goes through and
+    # supersedes the old manifest
+    chaos.reset()
+    svc.checkpoint()
+    assert open(path).read() != before
+
+
+def test_checkpoint_gc_sweeps_spill_and_stale_packs(tmp_path):
+    """Eviction spill packs and superseded checkpoint debris join the
+    retention policy: the post-checkpoint sweep removes packs for
+    vanished tenants, stale tmp files, and orphaned spill packs."""
+    from cause_tpu.serve import WriteAheadLog
+
+    spill = tmp_path / "spill"
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    q = IngestQueue(max_ops=4096, journal=w)
+    svc = SyncService(
+        q, residency=ResidencyManager(capacity=4,
+                                      spill_dir=str(spill)),
+        checkpoint_dir=str(tmp_path / "ckpt"), d_max=16)
+    base = _base()
+    a, b = _pair(base)
+    uuid = svc.add_tenant(a, b)
+    ck = tmp_path / "ckpt"
+    ck.mkdir(exist_ok=True)
+    (ck / "dead-tenant.ckpt.json").write_text("{}")
+    (ck / f"{uuid}.ckpt.json.tmp.4242").write_text("x")
+    (spill / "orphan.ckpt.json").write_text("{}")
+    svc.checkpoint()
+    names = set(os.listdir(ck))
+    assert f"{uuid}.ckpt.json" in names
+    assert "dead-tenant.ckpt.json" not in names
+    assert f"{uuid}.ckpt.json.tmp.4242" not in names
+    assert "orphan.ckpt.json" not in os.listdir(spill)
+    svc.close()

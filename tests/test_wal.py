@@ -1,0 +1,543 @@
+"""PR 15: the durable-storage lifecycle.
+
+Pins the segmented WAL's contracts without ever touching jax (the WAL
+is host work by definition):
+
+- **drop-in journal** — same record schema and ``iter_from`` contract
+  as ``IngestJournal``, seq resume across reopen, legacy single-file
+  journals still route through ``open_journal``;
+- **integrity** — every record carries a CRC32 trailer; a torn tail
+  counts in ``skipped``, a bit-rotted record fails its CRC and counts
+  in ``corrupt`` — detected, never silently replayed;
+- **lifecycle** — size/age rotation, and crash-safe GC: only sealed
+  fully-below-watermark segments retire, manifest-before-unlink,
+  replay above the watermark bit-identical before and after;
+- **fsync policy** — none/batch/always, measured by counting real
+  fsync calls;
+- **the disk chaos family** — seeded determinism, off-invariance,
+  and the exact degradation semantics: enospc/torn refuse the append
+  (admission's durability rung — never acked), bitrot acks but is
+  CRC-detected, fsync failure rotates with evidence, rename failure
+  aborts GC with segments intact;
+- **the scrubber** — finds what the faults left behind and exits
+  nonzero on corruption.
+"""
+
+import json
+import os
+
+import pytest
+
+from cause_tpu import chaos, obs, sync
+from cause_tpu.collections import shared as s
+from cause_tpu.serve import IngestQueue, WriteAheadLog, open_journal
+from cause_tpu.serve.ingest import IngestJournal
+from cause_tpu.serve.scrub import (bench_fsync, cli, scrub_checkpoints,
+                                   scrub_wal)
+from cause_tpu.serve.wal import decode_line, encode_record
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    for k in ("CAUSE_TPU_CHAOS", "CAUSE_TPU_OBS", "CAUSE_TPU_OBS_OUT",
+              "CAUSE_TPU_WAL_FSYNC"):
+        monkeypatch.delenv(k, raising=False)
+    chaos.reset()
+    obs.reset()
+    sync.quarantine_reset()
+    yield
+    chaos.reset()
+    obs.reset()
+    sync.quarantine_reset()
+
+
+def _events(name=None):
+    evs = [e for e in obs.events() if e.get("ev") == "event"]
+    if name is None:
+        return evs
+    return [e for e in evs if e.get("name") == name]
+
+
+def _arm(faults, seed=7):
+    chaos.configure(plan={"seed": seed, "faults": faults})
+
+
+def _fill(w, n, start=0, uuid="doc1", site="siteA"):
+    for i in range(n):
+        w.append(uuid, site, [{"k": start + i}])
+
+
+# ------------------------------------------------------------- codec
+
+
+def test_record_codec_roundtrip_and_classification():
+    rec = {"seq": 3, "uuid": "u", "site": "s",
+           "items": [{"a": "b\tc"}], "ts_us": 1}
+    line = encode_record(rec)
+    kind, e = decode_line(line)
+    assert kind == "rec" and e == rec
+    # legacy bare-JSON lines (the old IngestJournal format) still parse
+    kind, e = decode_line(json.dumps(rec) + "\n")
+    assert kind == "legacy" and e == rec
+    # a flipped byte in the body fails the CRC — corrupt, not a record
+    bad = line.replace('"seq": 3', '"seq": 7')
+    assert decode_line(bad)[0] == "corrupt"
+    # an unparseable prefix is torn; whitespace is blank
+    assert decode_line(line[: len(line) // 2])[0] == "torn"
+    assert decode_line("   \n")[0] == "blank"
+
+
+# --------------------------------------------------- journal contract
+
+
+def test_wal_roundtrip_seq_resume_and_iter_from(tmp_path):
+    p = str(tmp_path / "wal")
+    w = WriteAheadLog(p, fsync="none")
+    assert w.append("u1", "sA", [{"k": 0}]) == 1
+    assert w.append("u2", "sB", [{"k": 1}]) == 2
+    w.close()
+    # reopen resumes the seq counter (same contract as IngestJournal)
+    w2 = open_journal(p)
+    assert isinstance(w2, WriteAheadLog)
+    assert w2.append("u1", "sA", [{"k": 2}]) == 3
+    got = list(w2.iter_from(1))
+    assert [e["seq"] for e in got] == [2, 3]
+    assert got[0]["uuid"] == "u2" and got[0]["site"] == "sB"
+    assert got[0]["items"] == [{"k": 1}]
+    assert w2.skipped == 0 and w2.corrupt == 0
+    w2.close()
+
+
+def test_open_journal_routes_legacy_file_to_ingest_journal(tmp_path):
+    fp = str(tmp_path / "wal.jsonl")
+    j = IngestJournal(fp)
+    j.append("u", "s", [{"k": 1}])
+    j.close()
+    j2 = open_journal(fp)
+    assert isinstance(j2, IngestJournal) and j2.path == fp
+    assert [e["seq"] for e in j2.iter_from(0)] == [1]
+    j2.close()
+
+
+def test_crc_detects_bit_rot_on_disk(tmp_path):
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    _fill(w, 4)
+    w.close()
+    seg = os.path.join(w.path, "wal-00000001.seg")
+    data = bytearray(open(seg, "rb").read())
+    data[10] ^= 0x04  # rot one byte inside the first record
+    open(seg, "wb").write(bytes(data))
+    w2 = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    assert [e["seq"] for e in w2.iter_from(0)] == [2, 3, 4]
+    assert w2.corrupt == 1 and w2.skipped == 0
+    w2.close()
+
+
+# ----------------------------------------------------------- rotation
+
+
+def test_rotation_by_size_and_age(tmp_path):
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=150,
+                      fsync="none")
+    _fill(w, 6)
+    segs = sorted(n for n in os.listdir(w.path) if n.endswith(".seg"))
+    assert len(segs) >= 3
+    assert [e["seq"] for e in w.iter_from(0)] == list(range(1, 7))
+    w.close()
+    # age rotation: a tiny rotate_s seals the active segment between
+    # appends even though it is nowhere near the size bound
+    w2 = WriteAheadLog(str(tmp_path / "wal2"), rotate_s=0.0,
+                       fsync="none")
+    _fill(w2, 3)
+    segs = sorted(n for n in os.listdir(w2.path) if n.endswith(".seg"))
+    assert len(segs) == 3 and w2.stats["rotations"] == 2
+    w2.close()
+
+
+# ----------------------------------------------------------------- GC
+
+
+def test_gc_retires_below_watermark_and_replay_is_identical(tmp_path):
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=120,
+                      fsync="none")
+    _fill(w, 10)
+    before = list(w.iter_from(4))
+    rep = w.gc(4)
+    assert rep["retired"] >= 1 and not rep["aborted"]
+    # replay-after-GC above the watermark is bit-identical to before
+    assert list(w.iter_from(4)) == before
+    # only fully-below-watermark segments went: every surviving record
+    # above the watermark is still there, in order
+    assert [e["seq"] for e in w.iter_from(4)] == [5, 6, 7, 8, 9, 10]
+    # the manifest landed with the watermark (crash-safety anchor)
+    m = json.load(open(os.path.join(w.path, "wal_manifest.json")))
+    assert m["gc_watermark"] == 4 and m["~wal_manifest"] == 1
+    w.close()
+
+
+def test_gc_of_everything_still_resumes_seq(tmp_path):
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=60,
+                      fsync="none")
+    _fill(w, 5)
+    # seal the active segment by forcing one rotation, then retire all
+    w._rotate_locked()
+    w.gc(5)
+    assert list(w.iter_from(0)) == []
+    w.close()
+    # a fully-GC'd WAL must NOT reuse retired seqs on reopen — the
+    # manifest's max_seq carries the counter across the gap
+    w2 = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    assert w2.append("u", "s", [{"k": 9}]) == 6
+    w2.close()
+
+
+def test_gc_retire_dir_archives_instead_of_unlinking(tmp_path):
+    retired = str(tmp_path / "retired")
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=60,
+                      fsync="none", retire_dir=retired)
+    _fill(w, 6)
+    w.gc(3)
+    archived = sorted(os.listdir(retired))
+    assert archived  # segments moved aside, not destroyed
+    # the archived records are intact and below the watermark
+    from cause_tpu.serve.wal import scan_segment_file
+    seqs = []
+    for name in archived:
+        for kind, e in scan_segment_file(os.path.join(retired, name)):
+            assert kind == "rec"
+            seqs.append(e["seq"])
+    assert seqs == sorted(seqs) and max(seqs) <= 3
+    w.close()
+
+
+def test_dir_bytes_bounded_across_gc_cycles(tmp_path):
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=200,
+                      fsync="none")
+    sizes = []
+    for cycle in range(3):
+        _fill(w, 20, start=cycle * 20)
+        w.gc(w._seq)  # everything applied+checkpointed, says the test
+        sizes.append(w.dir_bytes())
+    # the unbounded baseline grows monotonically; the live dir doesn't
+    assert w.appended_bytes > max(sizes) * 2
+    assert max(sizes) <= min(sizes) * 3  # bounded, not monotone
+    w.close()
+
+
+# -------------------------------------------------------------- fsync
+
+
+def _count_fsyncs(monkeypatch):
+    calls = {"n": 0}
+    real = os.fsync
+
+    def counted(fd):
+        calls["n"] += 1
+        return real(fd)
+
+    monkeypatch.setattr(os, "fsync", counted)
+    return calls
+
+
+def test_fsync_policy_none_batch_always(tmp_path, monkeypatch):
+    calls = _count_fsyncs(monkeypatch)
+    w = WriteAheadLog(str(tmp_path / "a"), fsync="none")
+    _fill(w, 10)
+    w.close()
+    assert calls["n"] == 0
+    calls["n"] = 0
+    w = WriteAheadLog(str(tmp_path / "b"), fsync="always")
+    _fill(w, 10)
+    assert calls["n"] == 10
+    w.close()
+    calls["n"] = 0
+    w = WriteAheadLog(str(tmp_path / "c"), fsync="batch",
+                      fsync_batch_n=4, fsync_batch_ms=1e9)
+    _fill(w, 10)
+    assert calls["n"] == 2  # two full batches of 4; 2 pending
+    w.close()
+    assert calls["n"] == 3  # close flushes the stragglers
+
+
+def test_fsync_env_knob_and_bad_policy(tmp_path, monkeypatch):
+    monkeypatch.setenv("CAUSE_TPU_WAL_FSYNC", "always")
+    w = WriteAheadLog(str(tmp_path / "wal"))
+    assert w.fsync_policy == "always"
+    w.close()
+    with pytest.raises(ValueError):
+        WriteAheadLog(str(tmp_path / "wal2"), fsync="sometimes")
+
+
+def test_bench_fsync_reports_all_policies(tmp_path):
+    rep = bench_fsync(n=50, tmp_dir=str(tmp_path))
+    assert set(rep) == {"none", "batch", "always"}
+    for r in rep.values():
+        assert r["n"] == 50 and r["us_per_append"] > 0
+    assert rep["none"]["fsyncs"] == 0
+    assert rep["always"]["fsyncs"] == 50
+
+
+# --------------------------------------------------- disk chaos family
+
+
+def test_chaos_off_invariance(tmp_path):
+    # no CAUSE_TPU_CHAOS, no plan: every hook is inert and appends
+    # never fail — the production-path contract
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    _fill(w, 20)
+    assert w.stats["append_failures"] == 0
+    assert list(chaos.injected()) == []
+    w.close()
+
+
+def test_enospc_refuses_append_via_durability_rung(tmp_path):
+    _arm([{"family": "disk", "site": "serve.wal", "mode": "enospc",
+           "at": [2]}])
+    obs.configure(enabled=True)
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    q = IngestQueue(max_ops=64, journal=w)
+    import cause_tpu as c
+    from cause_tpu import serde
+    h = c.clist("v0", "v1")
+    items = serde.encode_node_items(dict(h.ct.nodes))
+    assert q.offer("doc1", "siteA", items).admitted
+    # second append hits the injected ENOSPC: never acked, refused
+    # with the durability rung + retry hint
+    adm = q.offer("doc1", "siteA", items)
+    assert not adm.admitted and adm.rung == "durability"
+    assert adm.reason == "wal-enospc"
+    assert adm.retry_after_ms is not None and adm.retry_after_ms > 0
+    assert q.stats["shed_by_rung"]["durability"] == 1
+    assert w.stats["append_failures"] == 1
+    # evidence: one serve.shed (rung durability) + one serve.disk
+    sheds = _events("serve.shed")
+    assert len(sheds) == 1
+    assert sheds[0]["fields"]["rung"] == "durability"
+    disks = _events("serve.disk")
+    assert len(disks) == 1
+    assert disks[0]["fields"]["op"] == "append"
+    assert disks[0]["fields"]["why"] == "enospc"
+    # storage recovered: the SAME offer admits (producer re-offer)
+    adm = q.offer("doc1", "siteA", items)
+    assert adm.admitted
+    # the journal holds exactly the acked seqs — no hole, no ghost
+    assert [e["seq"] for e in w.iter_from(0)] == [1, 2]
+    w.close()
+
+
+def test_torn_write_refuses_and_next_scan_counts_the_tear(tmp_path):
+    _arm([{"family": "disk", "site": "serve.wal", "mode": "torn",
+           "at": [2]}])
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    w.append("u", "s", [{"k": 0}])
+    with pytest.raises(s.CausalError) as ei:
+        w.append("u", "s", [{"k": 1}])
+    assert "wal-torn" in ei.value.info["causes"]
+    # the op was never acked; the torn prefix is on disk and the next
+    # append lands cleanly AFTER it
+    assert w.append("u", "s", [{"k": 2}]) == 2
+    assert [e["seq"] for e in w.iter_from(0)] == [1, 2]
+    assert w.skipped == 1 and w.corrupt == 0
+    w.close()
+
+
+def test_bitrot_acks_but_scan_detects_and_oracle_reads_chaos_log(
+        tmp_path):
+    _arm([{"family": "disk", "site": "serve.wal", "mode": "bitrot",
+           "at": [2]}])
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    w.append("u", "s", [{"k": 0}])
+    # the rotted append SUCCEEDS — the op was applied in memory and
+    # the next checkpoint persists it; detection is the scan's job
+    assert w.append("u", "s", [{"k": 1}]) == 2
+    w.append("u", "s", [{"k": 2}])
+    assert [e["seq"] for e in w.iter_from(0)] == [1, 3]
+    assert w.corrupt == 1 and w.skipped == 0
+    # the intact ground truth rides the injection log (the soak's
+    # oracle reads it back — the disk copy no longer has it)
+    rots = [r for r in chaos.injected() if r["mode"] == "bitrot"]
+    assert len(rots) == 1
+    assert rots[0]["rec"]["seq"] == 2
+    assert rots[0]["rec"]["items"] == [{"k": 1}]
+    w.close()
+
+
+def test_fsync_failure_rotates_with_evidence(tmp_path):
+    _arm([{"family": "disk", "site": "serve.wal", "mode": "fsync",
+           "at": [1]}])
+    obs.configure(enabled=True)
+    w = WriteAheadLog(str(tmp_path / "wal"), fsync="always")
+    w.append("u", "s", [{"k": 0}])  # fsync #1 fails -> rotate
+    w.append("u", "s", [{"k": 1}])
+    assert w.stats["fsync_failures"] == 1
+    assert w.stats["rotations"] == 1
+    disks = _events("serve.disk")
+    assert len(disks) == 1 and disks[0]["fields"]["op"] == "fsync"
+    assert [e["seq"] for e in w.iter_from(0)] == [1, 2]
+    w.close()
+
+
+def test_gc_rename_failure_aborts_with_segments_intact(tmp_path):
+    _arm([{"family": "disk", "site": "serve.wal", "mode": "rename",
+           "at": [1]}])
+    obs.configure(enabled=True)
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=60,
+                      fsync="none")
+    _fill(w, 6)
+    before = sorted(n for n in os.listdir(w.path) if n.endswith(".seg"))
+    rep = w.gc(6)
+    assert rep["aborted"] and rep["retired"] == 0
+    assert sorted(n for n in os.listdir(w.path)
+                  if n.endswith(".seg")) == before
+    assert w.gc_watermark == 0  # watermark unadvanced
+    disks = _events("serve.disk")
+    assert len(disks) == 1 and disks[0]["fields"]["op"] == "gc"
+    # next cycle (no fault): the same GC goes through
+    rep = w.gc(6)
+    assert not rep["aborted"] and rep["retired"] >= 1
+    w.close()
+
+
+def test_mid_gc_crash_leaves_replay_unaffected(tmp_path):
+    _arm([{"family": "crash", "site": "serve.wal.gc", "at": [1]}])
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=60,
+                      fsync="none")
+    _fill(w, 6)
+    before = list(w.iter_from(3))
+    from cause_tpu.serve.service import ServiceCrashed
+
+    with pytest.raises(ServiceCrashed):
+        w.gc(3)
+    w.close()
+    # crash landed AFTER the manifest, BEFORE segment retirement: the
+    # next incarnation replays identically and its next GC finishes
+    # the retirement
+    w2 = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    assert w2.gc_watermark == 3
+    assert list(w2.iter_from(3)) == before
+    rep = w2.gc(3)
+    assert rep["retired"] >= 1
+    assert list(w2.iter_from(3)) == before
+    w2.close()
+
+
+def test_disk_schedule_is_seed_deterministic(tmp_path):
+    plan = [{"family": "disk", "site": "serve.wal", "mode": "bitrot",
+             "prob": 0.3}]
+
+    def run(sub):
+        chaos.reset()
+        _arm(plan, seed=42)
+        w = WriteAheadLog(str(tmp_path / sub), fsync="none")
+        _fill(w, 30)
+        w.close()
+        return [(r["mode"], r["seq"], r.get("index"))
+                for r in chaos.injected()]
+
+    a, b = run("a"), run("b")
+    assert a == b and len(a) > 0  # same seed, same schedule, same flips
+
+
+# ------------------------------------------------------------ scrubber
+
+
+def test_scrub_clean_and_corrupt_exit_codes(tmp_path, capsys):
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=120,
+                      fsync="none")
+    _fill(w, 8)
+    w.close()
+    assert cli(["scrub", "--wal", w.path]) == 0
+    out = capsys.readouterr().out
+    assert "clean" in out
+    # rot a byte: the scrubber finds it and gates
+    seg = os.path.join(w.path, "wal-00000001.seg")
+    data = bytearray(open(seg, "rb").read())
+    data[8] ^= 0x01
+    open(seg, "wb").write(bytes(data))
+    assert cli(["scrub", "--wal", w.path, "--json"]) == 1
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["wal"]["crc_failures"] == 1
+    assert rep["wal"]["clean"] is False
+
+
+def test_scrub_reports_gc_eligible_bytes(tmp_path):
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=120,
+                      fsync="none")
+    _fill(w, 10)
+    w.close()
+    rep = scrub_wal(w.path, watermark=4)
+    assert rep["clean"] and rep["records"] == 10
+    assert rep["gc_eligible_segments"] >= 1
+    assert rep["gc_eligible_bytes"] > 0
+    # after the GC actually runs, nothing is eligible any more
+    w2 = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    w2.gc(4)
+    w2.close()
+    rep = scrub_wal(w2.path)  # watermark from the WAL manifest
+    assert rep["watermark"] == 4
+    assert rep["gc_eligible_segments"] == 0
+    assert rep["clean"]
+
+
+def test_scrub_checkpoints_flags_missing_and_bad_packs(tmp_path):
+    ck = tmp_path / "ckpt"
+    ck.mkdir()
+    manifest = {"~serve_manifest": 1, "gc_watermark": 5,
+                "tenants": {"u1": {"file": "u1.ckpt.json", "seq": 5},
+                            "u2": {"file": "u2.ckpt.json", "seq": 3}}}
+    (ck / "serve_manifest.json").write_text(json.dumps(manifest))
+    (ck / "u1.ckpt.json").write_text(json.dumps({"ok": 1}))
+    (ck / "u2.ckpt.json").write_text("{not json")
+    (ck / "stale.ckpt.json.tmp.999").write_text("x")
+    rep = scrub_checkpoints(str(ck))
+    assert rep["manifest_ok"] and rep["tenants"] == 2
+    assert rep["packs_ok"] == 1
+    assert rep["packs_bad"] == ["u2.ckpt.json"]
+    assert rep["stray_files"] == ["stale.ckpt.json.tmp.999"]
+    assert rep["errors"] == 1
+    assert rep["gc_watermark"] == 5
+    assert cli(["scrub", "--checkpoint", str(ck)]) == 1
+
+
+# ------------------------------------------------------- obs surfaces
+
+
+def test_live_fold_disk_axes_and_default_rules():
+    from cause_tpu.obs import live
+
+    fold = live.LiveFold()
+    ts = 1_000_000
+    fold.feed({"ev": "event", "name": "serve.tick", "ts_us": ts,
+               "fields": {"t_batch_ms": 5.0}})
+    fold.feed({"ev": "event", "name": "serve.disk", "ts_us": ts + 1,
+               "fields": {"op": "append", "why": "enospc"}})
+    fold.feed({"ev": "event", "name": "serve.journal_torn",
+               "ts_us": ts + 2, "fields": {"skipped": 2, "corrupt": 1,
+                                           "journal": "/w"}})
+    fold.feed({"ev": "gauge", "name": "serve.wal_bytes", "ts_us": ts,
+               "value": 4096})
+    fold.feed({"ev": "gauge", "name": "serve.wal_segments",
+               "ts_us": ts, "value": 3})
+    snap = fold.snapshot()
+    srv = snap["serve"]
+    assert srv["active"]
+    assert srv["disk_faults"] == 1
+    assert srv["journal_torn"] == 3  # skipped + corrupt
+    assert srv["wal_bytes"] == 4096 and srv["wal_segments"] == 3
+    # the default rules page on both axes (edge-triggered, serve-gated)
+    specs = live.DEFAULT_RULE_SPECS
+    assert "disk_faults>0" in specs and "journal_torn>0" in specs
+    fired = [r.check(snap) for r in live.default_rules()]
+    names = {f["rule"] for f in fired if f}
+    assert "disk_faults>0" in names and "journal_torn>0" in names
+
+
+def test_prometheus_exports_disk_metrics():
+    from cause_tpu.obs import watch
+
+    names = [m[0] for m in watch._PROM_METRICS]
+    for want in ("cause_tpu_live_serve_disk_faults_total",
+                 "cause_tpu_live_serve_journal_torn_total",
+                 "cause_tpu_live_serve_wal_segments",
+                 "cause_tpu_live_serve_wal_bytes"):
+        assert want in names
