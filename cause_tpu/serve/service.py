@@ -54,7 +54,7 @@ from ..collections import shared as s
 from .controller import BatchController
 from .ingest import IngestQueue
 from .residency import ResidencyManager
-from .wal import open_journal
+from .wal import fsync_dir, open_journal
 
 __all__ = ["ServiceCrashed", "SyncService"]
 
@@ -369,8 +369,15 @@ class SyncService:
             }
             path = os.path.join(out_dir, MANIFEST_NAME)
             tmp = f"{path}.tmp.{os.getpid()}"
+            # the rename below is the commit point the post-checkpoint
+            # GC trusts before it unlinks superseded packs and WAL
+            # segments — fsync the contents first (and the directory
+            # after) so a crash cannot persist the unlinks while
+            # losing the manifest that justified them
             with open(tmp, "w") as f:
                 f.write(json.dumps(manifest))
+                f.flush()
+                os.fsync(f.fileno())
             try:
                 if _chaos.enabled() \
                         and _chaos.disk_rename_fail("serve.checkpoint"):
@@ -395,6 +402,7 @@ class SyncService:
                     "(previous manifest intact)",
                     {"causes": {"checkpoint-rename"},
                      "path": path}) from e
+            fsync_dir(out_dir)
             if obs.enabled():
                 obs.counter("serve.checkpoints").inc()
             self._storage_gc(out_dir, min_seq, manifest)
@@ -408,7 +416,15 @@ class SyncService:
         superseded checkpoint packs + orphaned tmp files out of the
         checkpoint dir, and sweep stale residency spill packs. Runs
         only AFTER the manifest rename landed — everything removed is
-        re-derivable from the manifest + surviving journal suffix."""
+        re-derivable from the manifest + surviving journal suffix.
+
+        The checkpoint dir is assumed EXCLUSIVE to one service: the
+        sweep removes every ``*.ckpt.json``/``.tmp.`` file the current
+        manifest doesn't name, deliberately including debris a crashed
+        prior incarnation left behind (whose in-memory ownership is
+        unrecoverable). Two services — or an operator's manual
+        checkpoint — sharing one directory WOULD have their packs
+        swept by each other; point each at its own directory."""
         j = self.queue.journal
         wal_gc = None
         if j is not None and hasattr(j, "gc"):

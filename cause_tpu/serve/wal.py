@@ -66,7 +66,8 @@ from ..collections import shared as s
 from .ingest import IngestJournal
 
 __all__ = ["WriteAheadLog", "open_journal", "FSYNC_POLICIES",
-           "WAL_MANIFEST_NAME", "list_segments", "scan_segment_file"]
+           "WAL_MANIFEST_NAME", "list_segments", "scan_segment_file",
+           "fsync_dir"]
 
 FSYNC_POLICIES = ("none", "batch", "always")
 WAL_MANIFEST_NAME = "wal_manifest.json"
@@ -121,6 +122,24 @@ def decode_line(line: str) -> Tuple[str, Optional[dict]]:
     if isinstance(e, dict) and "seq" in e:
         return ("legacy", e)
     return ("torn", None)
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of a DIRECTORY — makes a just-landed rename
+    (or unlink) durable on POSIX. Some platforms refuse to open a
+    directory read-only or to fsync the fd; both are quietly fine
+    (the file-content fsync before the rename carries the integrity
+    guarantee, this carries the name)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover - platform-dependent
+        pass
+    finally:
+        os.close(fd)
 
 
 def list_segments(path: str) -> List[Tuple[int, str]]:
@@ -273,9 +292,19 @@ class WriteAheadLog:
              "ts_us": time.time_ns() // 1000}
         p = os.path.join(self.path, WAL_MANIFEST_NAME)
         tmp = f"{p}.tmp.{os.getpid()}"
+        # the rename below is gc()'s crash-safe commit point BEFORE
+        # segments are unlinked — it must be durable regardless of the
+        # append fsync policy, or a crash could persist the unlinks
+        # while losing the manifest (watermark/max_seq reset to 0 and
+        # the seq counter would reuse retired seqs). An OSError here
+        # propagates and aborts the GC with segments intact, same as a
+        # failed os.replace would.
         with open(tmp, "w") as f:
             f.write(json.dumps(m))
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, p)
+        fsync_dir(self.path)
 
     def _open_active_locked(self, no: int) -> None:
         name = f"{_SEG_PREFIX}{no:08d}{_SEG_SUFFIX}"
@@ -383,9 +412,17 @@ class WriteAheadLog:
         if p == "always" or self._pending_fsync >= self.fsync_batch_n \
                 or (now - self._last_fsync_s) * 1000.0 \
                 >= self.fsync_batch_ms:
-            self._fsync_locked(now)
+            if not self._fsync_locked(now):
+                # a descriptor that failed fsync has undefined durable
+                # state: rotate to a fresh segment/fd
+                self._rotate_locked(final_sync=False)
 
-    def _fsync_locked(self, now: Optional[float] = None) -> None:
+    def _fsync_locked(self, now: Optional[float] = None) -> bool:
+        """fsync the active descriptor; returns success. Never rotates
+        — the CALLER decides what a failure means, because this runs
+        both standalone (append path — rotate to a fresh fd) and as a
+        rotation's final sync (rotating from in here would reenter
+        ``_rotate_locked`` and seal the same segment twice)."""
         ok = True
         if _chaos.enabled() and _chaos.disk_fsync_fail(_CHAOS_SITE):
             ok = False
@@ -397,13 +434,11 @@ class WriteAheadLog:
         if ok:
             self.stats["fsyncs"] += 1
         else:
-            # a descriptor that failed fsync has undefined durable
-            # state: evidence, then rotate to a fresh segment/fd
             self.stats["fsync_failures"] += 1
             self._disk_event("fsync", "fsync-failed")
-            self._rotate_locked(final_sync=False)
         self._pending_fsync = 0
         self._last_fsync_s = now if now is not None else time.monotonic()
+        return ok
 
     # ------------------------------------------------------ rotation
 
@@ -423,6 +458,9 @@ class WriteAheadLog:
             return
         if final_sync and self.fsync_policy != "none" \
                 and self._pending_fsync:
+            # failure is evidenced inside; no further action here —
+            # this fd is being retired anyway and its replacement is
+            # a fresh descriptor
             self._fsync_locked()
         try:
             self._fh.close()

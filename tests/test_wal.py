@@ -377,6 +377,41 @@ def test_fsync_failure_rotates_with_evidence(tmp_path):
     w.close()
 
 
+def test_fsync_failure_during_rotation_replays_exactly_once(tmp_path):
+    """The reentrancy trap: pending batched appends + a size-triggered
+    rotation whose FINAL sync fails. The failed sync must not rotate
+    from inside the rotation (that would seal the same segment twice —
+    duplicate ``_index`` entry, duplicate replay, double-counted
+    gauges); the segment seals exactly once and every seq replays
+    exactly once."""
+    _arm([{"family": "disk", "site": "serve.wal", "mode": "fsync",
+           "at": [1]}])
+    obs.configure(enabled=True)
+    # batch thresholds no append can hit: the only _fsync_locked calls
+    # are rotations' final syncs, and the first of those fails
+    w = WriteAheadLog(str(tmp_path / "wal"), rotate_bytes=60,
+                      fsync="batch", fsync_batch_n=10_000,
+                      fsync_batch_ms=1e9)
+    _fill(w, 6)
+    assert w.stats["fsync_failures"] == 1
+    disks = _events("serve.disk")
+    assert len(disks) == 1 and disks[0]["fields"]["op"] == "fsync"
+    # exactly one _index entry per sealed segment file, none repeated
+    index_names = [sg["name"] for sg in w._index]
+    assert len(index_names) == len(set(index_names))
+    segs_on_disk = sorted(n for n in os.listdir(w.path)
+                          if n.endswith(".seg"))
+    assert sorted(index_names + [w._active["name"]]) == segs_on_disk
+    # the replay contract: every seq exactly once, in order
+    seqs = [e["seq"] for e in w.iter_from(0)]
+    assert seqs == [1, 2, 3, 4, 5, 6]
+    w.close()
+    # a reopen (index rebuilt from disk) replays identically
+    w2 = WriteAheadLog(str(tmp_path / "wal"), fsync="none")
+    assert [e["seq"] for e in w2.iter_from(0)] == [1, 2, 3, 4, 5, 6]
+    w2.close()
+
+
 def test_gc_rename_failure_aborts_with_segments_intact(tmp_path):
     _arm([{"family": "disk", "site": "serve.wal", "mode": "rename",
            "at": [1]}])
