@@ -18,8 +18,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m cause_tpu.analysis",
         description=("causelint: trace-identity (TID), jit-purity "
-                     "(JPH), obs-off invariance (OBS) and lane-cache "
-                     "aliasing (LCA) static analysis"),
+                     "(JPH), obs-off invariance (OBS), lane-cache "
+                     "aliasing (LCA), concurrency (LCK), durability "
+                     "(DUR) and refusal-evidence (EVD) static "
+                     "analysis"),
     )
     ap.add_argument("paths", nargs="*",
                     help="files/directories to analyze (default: "
@@ -34,6 +36,17 @@ def main(argv=None) -> int:
                          "--write-baseline); only NEW findings gate")
     ap.add_argument("--write-baseline", metavar="FILE",
                     help="record current findings into FILE and exit 0")
+    ap.add_argument("--cache", metavar="FILE",
+                    help="memoize the verdict keyed on file sha1s + "
+                         "rule-set version; a warm hit replays the "
+                         "result without parsing anything")
+    ap.add_argument("--changed", metavar="GIT_REF",
+                    help="report only findings in files that differ "
+                         "from GIT_REF (tracked diffs + untracked); "
+                         "exits 0 fast when nothing changed. The "
+                         "whole program is still analyzed (the call "
+                         "graph is cross-module) — combine with "
+                         "--cache to make that cheap")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -69,7 +82,33 @@ def main(argv=None) -> int:
         # explicitly emptied selection still reports parse errors
         rule_ids = [r for r in rule_ids if not r.startswith("GEN")]
 
-    result = core.run(paths, rule_ids=rule_ids)
+    # --changed narrows the REPORT, not the analysis: the call graph
+    # is cross-module (a helper in an unchanged file can prove a
+    # changed file's refusal path emits evidence), so analyzing only
+    # the diff would both miss and invent findings. The whole program
+    # is still analyzed — the cache makes that cheap — and findings
+    # are then filtered to files that differ from the ref.
+    changed_set = None
+    if args.changed:
+        subset = core.changed_files(paths, args.changed)
+        if subset is None:
+            print(f"causelint: cannot diff against {args.changed!r} "
+                  "(not a git checkout, or the ref does not resolve); "
+                  "running the full analysis", file=sys.stderr)
+        elif not subset:
+            print(f"causelint: no analyzed files changed vs "
+                  f"{args.changed}")
+            return 0
+        else:
+            changed_set = {os.path.abspath(p) for p in subset}
+
+    result = core.cached_run(paths, rule_ids=rule_ids,
+                             cache_path=args.cache)
+    if changed_set is not None:
+        result.findings = [f for f in result.findings
+                           if os.path.abspath(f.path) in changed_set]
+        result.suppressed = [f for f in result.suppressed
+                             if os.path.abspath(f.path) in changed_set]
 
     if args.write_baseline:
         n = report.write_baseline(args.write_baseline, result)

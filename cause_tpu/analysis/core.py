@@ -20,14 +20,20 @@ from __future__ import annotations
 
 import hashlib
 import io
+import json
 import os
 import re
+import subprocess
 import tokenize
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from .callgraph import ModuleInfo, build_program
-from .rules import Context, Finding, REGISTRY
+from .rules import Context, Finding, REGISTRY, RULESET_VERSION
+
+# importing these populates REGISTRY with the LCK/DUR/EVD families
+from . import concurrency as _concurrency  # noqa: F401  (registration)
+from . import protocol as _protocol        # noqa: F401  (registration)
 
 _SUPPRESS_RE = re.compile(
     r"#\s*causelint:\s*disable(?P<next>-next-line)?\s*=\s*"
@@ -118,6 +124,22 @@ def collect_files(paths: List[str]) -> List[str]:
     return uniq
 
 
+def _default_root(files: List[str]) -> str:
+    """cwd when every analyzed file lives under it (the normal
+    from-the-repo invocation), else the files' common ancestor.
+    Module names — and with them the package-scoped rules (OBS001's
+    obs scope, DUR002/EVD001's serve/net scopes) — derive from paths
+    relative to this root; falling back to bare stems for
+    outside-the-root files would silently disable those rules when
+    the CLI is invoked from elsewhere with absolute paths."""
+    cwd = os.getcwd()
+    ab = [os.path.abspath(f) for f in files]
+    prefix = cwd.rstrip(os.sep) + os.sep
+    if not ab or all(f.startswith(prefix) for f in ab):
+        return cwd
+    return os.path.commonpath([os.path.dirname(f) for f in ab])
+
+
 def fingerprint(f: Finding, root: str) -> str:
     """Line-number-independent identity of a finding, for baselines:
     unrelated edits above a frozen finding must not unfreeze it."""
@@ -134,8 +156,8 @@ def run(paths: List[str], root: Optional[str] = None,
     ``rule_ids=None`` runs every rule; an explicit empty list runs
     none (GEN findings — parse errors, unused suppressions — are the
     driver's own and always emitted on full runs)."""
-    root = root or os.getcwd()
     files = collect_files(paths)
+    root = root or _default_root(files)
     program = build_program(files, root)
     ctx = Context(program)
     full_run = rule_ids is None
@@ -182,6 +204,109 @@ def run(paths: List[str], root: Optional[str] = None,
     return result
 
 
+# --------------------------------------------------- incremental runs
+
+def _hash_files(files: List[str], root: str) -> Dict[str, str]:
+    """relpath -> content sha1 for every analyzed file (the cache
+    key, alongside the rule-set version)."""
+    out: Dict[str, str] = {}
+    aroot = os.path.abspath(root)
+    for p in files:
+        rel = os.path.relpath(os.path.abspath(p), aroot)
+        try:
+            with open(p, "rb") as f:
+                out[rel] = hashlib.sha1(f.read()).hexdigest()
+        except OSError:
+            out[rel] = ""
+    return out
+
+
+def _finding_to_list(f: Finding) -> list:
+    return [f.rule, f.path, f.line, f.col, f.message, f.snippet]
+
+
+def cached_run(paths: List[str], root: Optional[str] = None,
+               rule_ids: Optional[List[str]] = None,
+               cache_path: Optional[str] = None) -> AnalysisResult:
+    """``run()`` behind a content-hash memo: when every analyzed
+    file's sha1 and the rule-set version match the cache, the previous
+    verdict replays without parsing a single file (the warm CI path).
+    ANY change re-runs the WHOLE analysis — the call graph is
+    cross-module, so per-file verdict reuse would be unsound (a
+    signature change in one file creates findings in another). A
+    ``RULESET_VERSION`` bump invalidates every cached verdict even
+    when no analyzed file changed."""
+    if cache_path is None:
+        return run(paths, root=root, rule_ids=rule_ids)
+    files = collect_files(paths)
+    root = root or _default_root(files)
+    hashes = _hash_files(files, root)
+    key_rules = sorted(rule_ids) if rule_ids is not None else None
+    try:
+        with open(cache_path) as f:
+            cached = json.load(f)
+    except (OSError, ValueError):
+        cached = None
+    if (isinstance(cached, dict)
+            and cached.get("ruleset") == RULESET_VERSION
+            and cached.get("rules") == key_rules
+            and cached.get("hashes") == hashes):
+        res = AnalysisResult(files=len(files), root=root)
+        res.findings = [Finding(*v) for v in cached["findings"]]
+        res.suppressed = [Finding(*v) for v in cached["suppressed"]]
+        return res
+    res = run(paths, root=root, rule_ids=rule_ids)
+    payload = {
+        "ruleset": RULESET_VERSION,
+        "rules": key_rules,
+        "hashes": hashes,
+        "findings": [_finding_to_list(f) for f in res.findings],
+        "suppressed": [_finding_to_list(f) for f in res.suppressed],
+    }
+    tmp = f"{cache_path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, cache_path)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return res
+
+
+def changed_files(paths: List[str], ref: str,
+                  root: Optional[str] = None) -> Optional[List[str]]:
+    """The subset of ``collect_files(paths)`` that differs from git
+    ``ref`` (tracked diffs plus untracked files). None when git is
+    unavailable or ``ref`` does not resolve — callers fall back to a
+    full run rather than silently analyzing nothing."""
+    root = root or os.getcwd()
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "-z", ref, "--"],
+            capture_output=True, text=True, cwd=root, timeout=60)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard", "-z"],
+            capture_output=True, text=True, cwd=root, timeout=60)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    if diff.returncode != 0 or untracked.returncode != 0:
+        return None
+    changed = {p for out in (diff.stdout, untracked.stdout)
+               for p in out.split("\0") if p}
+    aroot = os.path.abspath(root)
+    out = []
+    for p in collect_files(paths):
+        rel = os.path.relpath(os.path.abspath(p), aroot)
+        if rel in changed:
+            out.append(p)
+    return out
+
+
 def _check_module(ctx: Context, module: ModuleInfo,
                   selected) -> List[Finding]:
     findings: List[Finding] = []
@@ -211,6 +336,8 @@ def list_rules() -> List[tuple]:
 __all__ = [
     "AnalysisResult",
     "Finding",
+    "cached_run",
+    "changed_files",
     "collect_files",
     "fingerprint",
     "list_rules",

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 import ast
 from dataclasses import dataclass
-from typing import Iterator, List, Optional
+from typing import FrozenSet, Iterator, List, NamedTuple, Optional
 
 from .callgraph import FuncInfo, ModuleInfo, Program, dotted_parts
 
@@ -73,6 +73,11 @@ class RuleSpec:
 
 
 REGISTRY: dict = {}
+
+# Bumped whenever rule logic or the rule set changes; the incremental
+# cache (core.cached_run) keys on it so a rule-set change invalidates
+# every cached verdict even when no analyzed file changed.
+RULESET_VERSION = 2
 
 
 def rule(rule_id: str, help_text: str):
@@ -514,12 +519,6 @@ def check_obs002(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
                 "event(), which collapse to shared no-ops")
 
 
-_DEVPROF_APIS = frozenset(
-    {"profile_program", "program_cost", "sample_device_memory",
-     "arena_footprint"}
-)
-
-
 def _is_enabled_name(name: str) -> bool:
     """The sanctioned guard in any of the repo's import spellings:
     ``obs.enabled()``, ``devprof.enabled()``, or the aliased
@@ -605,214 +604,188 @@ def _calls_with_guards(info: FuncInfo):
         yield from walk(info.node.body, False)
 
 
-@rule("OBS003",
-      "devprof API reached from jit-reachable code without an "
-      "obs.enabled() guard (device-program telemetry samples live "
-      "arrays and AOT-compiles the moment obs is on)")
-def check_obs003(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module):
-        return
-    for info in ctx.reachable_funcs(module):
-        for call, guarded in _calls_with_guards(info):
-            parts = dotted_parts(call.func)
-            if parts is None:
-                continue
-            if _is_enabled_name(parts[-1]):
-                # devprof.enabled() IS the sanctioned guard — flagging
-                # it would gate the exact pattern the docs prescribe
-                continue
-            is_devprof = (
-                parts[-1] in _DEVPROF_APIS
-                or any(p in ("devprof", "_devprof")
-                       for p in parts[:-1])
-            )
-            if is_devprof and not guarded:
-                yield _finding(
-                    "OBS003", module, call,
-                    f"devprof.{parts[-1]}() on a jit-reachable path "
-                    "without an obs.enabled() guard — unlike the "
-                    "no-op span/counter factories, devprof does real "
-                    "work when obs is on; gate the call (or hoist it "
-                    "off the traced path)")
+# --------------------------------------------- guarded-API rule table
+#
+# OBS003-007, CHS001, SRV001, NET001 and DSK001 all share one shape:
+# a subsystem whose APIs do real host work the moment obs (or chaos)
+# is on, matched by distinctive bare names plus module qualifiers,
+# excused by the sanctioned ...enabled() guard spellings, and scoped
+# away from the subsystem's own package. Only the table rows differ —
+# the per-PR copy-paste of the checker body was the dominant growth
+# cost of this file, so the rows are data now. Rule ids, help texts,
+# messages, fixtures and suppressions are unchanged.
 
 
-_SEMANTIC_APIS = frozenset(
-    {"sync_applied", "sync_full_bag", "sync_rejected",
-     "sync_quarantined", "sync_readmitted", "observe_wave",
-     "session_overflow", "token_headroom", "gc_compacted",
-     "lazy_materialized", "fleet_report"}
+class _GuardSpec(NamedTuple):
+    rule_id: str
+    help: str
+    apis: FrozenSet[str]       # distinctive bare names
+    quals: FrozenSet[str]      # module-qualifier spellings
+    skip: object               # module -> bool, extra exclusions
+    guard_desc: str            # "an obs.enabled()" / chaos variant
+    work_desc: str             # why the call is real work
+    prefix: Optional[str] = None   # message head; None -> dotted path
+    sanctioned: FrozenSet[str] = frozenset()
+
+
+_GUARD_RULES = (
+    _GuardSpec(
+        "OBS003",
+        "devprof API reached from jit-reachable code without an "
+        "obs.enabled() guard (device-program telemetry samples live "
+        "arrays and AOT-compiles the moment obs is on)",
+        frozenset({"profile_program", "program_cost",
+                   "sample_device_memory", "arena_footprint"}),
+        frozenset({"devprof", "_devprof"}),
+        lambda module: False,
+        "an obs.enabled()",
+        "unlike the no-op span/counter factories, devprof does real "
+        "work when obs is on",
+        prefix="devprof"),
+    _GuardSpec(
+        "OBS004",
+        "semantic-event/fleet API reached from jit-reachable code "
+        "without an obs.enabled() guard (the CRDT-semantic layer "
+        "assembles real field dicts and walks weaves/version vectors "
+        "the moment obs is on)",
+        frozenset({"sync_applied", "sync_full_bag", "sync_rejected",
+                   "sync_quarantined", "sync_readmitted",
+                   "observe_wave", "session_overflow",
+                   "token_headroom", "gc_compacted",
+                   "lazy_materialized", "fleet_report"}),
+        frozenset({"semantic", "_semantic", "_sem"}),
+        lambda module: False,
+        "an obs.enabled()",
+        "unlike the no-op span/counter factories, the semantic layer "
+        "builds event payloads (staleness bookkeeping, weave scans) "
+        "when obs is on",
+        prefix="semantic"),
+    _GuardSpec(
+        "OBS005",
+        "costmodel API reached from jit-reachable code without an "
+        "obs.enabled() guard (the wave cost model takes locks and "
+        "assembles dispatch/divergence records the moment obs is on)",
+        frozenset({"record_dispatch", "register_program",
+                   "note_delta_ops", "note_full_bag", "wave_begin",
+                   "wave_abandon", "wave_cost", "costmodel_digest",
+                   "cost_vs_divergence", "gap_report"}),
+        frozenset({"costmodel", "_costmodel", "_cm"}),
+        lambda module: False,
+        "an obs.enabled()",
+        "unlike the no-op span/counter factories, the cost model "
+        "takes registry locks and builds per-wave dispatch records "
+        "when obs is on",
+        prefix="costmodel"),
+    _GuardSpec(
+        "OBS006",
+        "convergence-lag API reached from jit-reachable code without "
+        "an obs.enabled() guard (the lag tracer takes registry locks, "
+        "stamps wall clocks and assembles per-op records the moment "
+        "obs is on)",
+        frozenset({"op_created", "ops_applied", "wave_observed",
+                   "level_observed", "pending_ops", "lag_summary",
+                   "set_slo"}),
+        frozenset({"lag", "_lag"}),
+        lambda module: False,
+        "an obs.enabled()",
+        "unlike the no-op span/counter factories, the lag tracer "
+        "reads monotonic clocks and mutates the bounded op registry "
+        "when obs is on",
+        prefix="lag"),
+    # distinctive bare names only: generic verbs (attach/feed/poll/
+    # snapshot) are matched through the ``live`` module qualifier, or
+    # they would flag every unrelated object with a feed()
+    _GuardSpec(
+        "OBS007",
+        "live-telemetry API reached from jit-reachable code without "
+        "an obs.enabled() guard (the live layer folds records, takes "
+        "monitor locks and evaluates alert rules the moment obs is "
+        "on)",
+        frozenset({"LiveMonitor", "LiveFold", "LiveAttachment",
+                   "emit_snapshot", "default_rules", "parse_rule"}),
+        frozenset({"live", "_live"}),
+        lambda module: False,
+        "an obs.enabled()",
+        "unlike the no-op span/counter factories, the live monitor "
+        "drains subscriber queues, folds records and evaluates alert "
+        "rules when obs is on",
+        prefix="live"),
+    # ``run_dispatch``/``is_transient`` are SANCTIONED unguarded —
+    # run_dispatch IS the dispatch path (its idle cost is one
+    # chaos.enabled() read and a try frame)
+    _GuardSpec(
+        "CHS001",
+        "chaos/recovery API reached from jit-reachable code without a "
+        "chaos.enabled()/obs.enabled() guard (fault hooks draw RNG "
+        "and take the engine lock; recovery telemetry assembles event "
+        "payloads the moment obs is on)",
+        frozenset({"mangle_items", "dispatch_fault", "budget_exhaust",
+                   "should_crash", "stall_point", "chaos_report",
+                   "restore_recorded"}),
+        frozenset({"chaos", "_chaos", "recovery", "_recovery"}),
+        lambda module: ("chaos" in module.segments
+                        or module.segments[-1] == "recovery"),
+        "a chaos.enabled()/obs.enabled()",
+        "fault hooks advance seeded RNG streams under the engine lock "
+        "and recovery telemetry builds event payloads when enabled",
+        sanctioned=frozenset({"run_dispatch", "is_transient",
+                              "suspended"})),
+    # distinctive bare names per subsystem; generic verbs (offer/
+    # drain, pump/dial, append/gc) are matched through the module
+    # qualifiers instead, or they would flag every unrelated queue,
+    # socket helper and list.append in the tree. These layers are
+    # HOST work by definition (locks, sockets, fsyncs) — reaching
+    # them from jit-reachable code unguarded is a structural smell,
+    # not just an overhead one.
+    _GuardSpec(
+        "SRV001",
+        "sync-service API reached from jit-reachable code without an "
+        "obs.enabled() guard (the serve layer takes admission-queue "
+        "locks, appends to the write-ahead journal and packs/restores "
+        "checkpoint-grade state — host lifecycle work that must "
+        "never sit on a traced path)",
+        frozenset({"IngestQueue", "IngestJournal", "BatchController",
+                   "ResidencyManager", "SyncService"}),
+        frozenset({"serve", "_serve"}),
+        lambda module: "serve" in module.segments,
+        "an obs.enabled()",
+        "the serve layer takes queue locks, journals admissions and "
+        "spills/restores checkpoint packs"),
+    _GuardSpec(
+        "NET001",
+        "network-transport API reached from jit-reachable code "
+        "without an obs.enabled() guard (the net layer blocks on "
+        "sockets, sleeps out reconnect backoff and takes connection "
+        "locks — host transport work that must never sit on a traced "
+        "path)",
+        frozenset({"NetClient", "ReplicationServer", "FrameStream",
+                   "Backoff", "loopback_pair"}),
+        frozenset({"net", "_net", "transport", "_transport"}),
+        lambda module: "net" in module.segments,
+        "an obs.enabled()",
+        "the net layer blocks on socket IO, sleeps out backoff "
+        "ladders and mutates connection state"),
+    _GuardSpec(
+        "DSK001",
+        "WAL/scrubber API reached from jit-reachable code without an "
+        "obs.enabled() guard (the durable-storage layer fsyncs file "
+        "descriptors, rotates/retires segment files and walks "
+        "segment directories re-checking CRCs — host storage work "
+        "that must never sit on a traced path)",
+        frozenset({"WriteAheadLog", "open_journal", "scrub_wal",
+                   "scrub_checkpoints", "bench_fsync"}),
+        frozenset({"wal", "_wal", "scrub", "_scrub"}),
+        lambda module: "serve" in module.segments,
+        "an obs.enabled()",
+        "the durable-storage layer fsyncs descriptors, rotates and "
+        "retires segment files and re-checks CRCs over whole "
+        "directories"),
 )
 
 
-@rule("OBS004",
-      "semantic-event/fleet API reached from jit-reachable code "
-      "without an obs.enabled() guard (the CRDT-semantic layer "
-      "assembles real field dicts and walks weaves/version vectors "
-      "the moment obs is on)")
-def check_obs004(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module):
-        return
-    for info in ctx.reachable_funcs(module):
-        for call, guarded in _calls_with_guards(info):
-            parts = dotted_parts(call.func)
-            if parts is None:
-                continue
-            if _is_enabled_name(parts[-1]):
-                # semantic.enabled() IS the sanctioned guard
-                continue
-            is_semantic = (
-                parts[-1] in _SEMANTIC_APIS
-                or any(p in ("semantic", "_semantic", "_sem")
-                       for p in parts[:-1])
-            )
-            if is_semantic and not guarded:
-                yield _finding(
-                    "OBS004", module, call,
-                    f"semantic.{parts[-1]}() on a jit-reachable path "
-                    "without an obs.enabled() guard — unlike the "
-                    "no-op span/counter factories, the semantic layer "
-                    "builds event payloads (staleness bookkeeping, "
-                    "weave scans) when obs is on; gate the call (or "
-                    "hoist it off the traced path)")
-
-
-_COSTMODEL_APIS = frozenset(
-    {"record_dispatch", "register_program", "note_delta_ops",
-     "note_full_bag", "wave_begin", "wave_abandon", "wave_cost",
-     "costmodel_digest", "cost_vs_divergence", "gap_report"}
-)
-
-
-@rule("OBS005",
-      "costmodel API reached from jit-reachable code without an "
-      "obs.enabled() guard (the wave cost model takes locks and "
-      "assembles dispatch/divergence records the moment obs is on)")
-def check_obs005(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module):
-        return
-    for info in ctx.reachable_funcs(module):
-        for call, guarded in _calls_with_guards(info):
-            parts = dotted_parts(call.func)
-            if parts is None:
-                continue
-            if _is_enabled_name(parts[-1]):
-                # costmodel.enabled() IS the sanctioned guard
-                continue
-            is_costmodel = (
-                parts[-1] in _COSTMODEL_APIS
-                or any(p in ("costmodel", "_costmodel", "_cm")
-                       for p in parts[:-1])
-            )
-            if is_costmodel and not guarded:
-                yield _finding(
-                    "OBS005", module, call,
-                    f"costmodel.{parts[-1]}() on a jit-reachable path "
-                    "without an obs.enabled() guard — unlike the "
-                    "no-op span/counter factories, the cost model "
-                    "takes registry locks and builds per-wave "
-                    "dispatch records when obs is on; gate the call "
-                    "(or hoist it off the traced path)")
-
-
-_LAG_APIS = frozenset(
-    {"op_created", "ops_applied", "wave_observed", "level_observed",
-     "pending_ops", "lag_summary", "set_slo"}
-)
-
-
-@rule("OBS006",
-      "convergence-lag API reached from jit-reachable code without an "
-      "obs.enabled() guard (the lag tracer takes registry locks, "
-      "stamps wall clocks and assembles per-op records the moment obs "
-      "is on)")
-def check_obs006(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module):
-        return
-    for info in ctx.reachable_funcs(module):
-        for call, guarded in _calls_with_guards(info):
-            parts = dotted_parts(call.func)
-            if parts is None:
-                continue
-            if _is_enabled_name(parts[-1]):
-                # lag.enabled() IS the sanctioned guard
-                continue
-            is_lag = (
-                parts[-1] in _LAG_APIS
-                or any(p in ("lag", "_lag") for p in parts[:-1])
-            )
-            if is_lag and not guarded:
-                yield _finding(
-                    "OBS006", module, call,
-                    f"lag.{parts[-1]}() on a jit-reachable path "
-                    "without an obs.enabled() guard — unlike the "
-                    "no-op span/counter factories, the lag tracer "
-                    "reads monotonic clocks and mutates the bounded "
-                    "op registry when obs is on; gate the call (or "
-                    "hoist it off the traced path)")
-
-
-# distinctive bare names only: generic verbs (attach/feed/poll/
-# snapshot) are matched through their ``live`` module qualifier
-# instead, or they would flag every unrelated object with a feed()
-_LIVE_APIS = frozenset(
-    {"LiveMonitor", "LiveFold", "LiveAttachment", "emit_snapshot",
-     "default_rules", "parse_rule"}
-)
-
-
-@rule("OBS007",
-      "live-telemetry API reached from jit-reachable code without an "
-      "obs.enabled() guard (the live layer folds records, takes "
-      "monitor locks and evaluates alert rules the moment obs is on)")
-def check_obs007(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module):
-        return
-    for info in ctx.reachable_funcs(module):
-        for call, guarded in _calls_with_guards(info):
-            parts = dotted_parts(call.func)
-            if parts is None:
-                continue
-            if _is_enabled_name(parts[-1]):
-                # live.enabled()-style guards ARE the sanctioned guard
-                continue
-            is_live = (
-                parts[-1] in _LIVE_APIS
-                or any(p in ("live", "_live") for p in parts[:-1])
-            )
-            if is_live and not guarded:
-                yield _finding(
-                    "OBS007", module, call,
-                    f"live.{parts[-1]}() on a jit-reachable path "
-                    "without an obs.enabled() guard — unlike the "
-                    "no-op span/counter factories, the live monitor "
-                    "drains subscriber queues, folds records and "
-                    "evaluates alert rules when obs is on; gate the "
-                    "call (or hoist it off the traced path)")
-
-
-# distinctive bare names for the chaos-engine hooks and the recovery
-# ladder's telemetry; generic spellings are matched through their
-# module qualifier. ``run_dispatch``/``is_transient`` are SANCTIONED
-# unguarded — run_dispatch IS the dispatch path (its idle cost is one
-# chaos.enabled() read and a try frame), and the `enabled` spellings
-# are the guard itself.
-_CHAOS_APIS = frozenset(
-    {"mangle_items", "dispatch_fault", "budget_exhaust",
-     "should_crash", "stall_point", "chaos_report",
-     "restore_recorded"}
-)
-_CHS_SANCTIONED = frozenset({"run_dispatch", "is_transient",
-                             "suspended"})
-
-
-@rule("CHS001",
-      "chaos/recovery API reached from jit-reachable code without a "
-      "chaos.enabled()/obs.enabled() guard (fault hooks draw RNG and "
-      "take the engine lock; recovery telemetry assembles event "
-      "payloads the moment obs is on)")
-def check_chs001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module) or "chaos" in module.segments \
-            or module.segments[-1] == "recovery":
+def _check_guarded_api(spec: _GuardSpec, ctx: Context,
+                       module: ModuleInfo) -> Iterator[Finding]:
+    if _in_obs_package(module) or spec.skip(module):
         return
     for info in ctx.reachable_funcs(module):
         for call, guarded in _calls_with_guards(info):
@@ -820,160 +793,30 @@ def check_chs001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
             if parts is None:
                 continue
             if _is_enabled_name(parts[-1]) \
-                    or parts[-1] in _CHS_SANCTIONED:
+                    or parts[-1] in spec.sanctioned:
+                # ...enabled() IS the sanctioned guard — flagging it
+                # would gate the exact pattern the docs prescribe
                 continue
-            is_chs = (
-                parts[-1] in _CHAOS_APIS
-                or any(p in ("chaos", "_chaos", "recovery",
-                             "_recovery") for p in parts[:-1])
-            )
-            if is_chs and not guarded:
+            hit = (parts[-1] in spec.apis
+                   or any(q in spec.quals for q in parts[:-1]))
+            if hit and not guarded:
+                head = (f"{spec.prefix}.{parts[-1]}" if spec.prefix
+                        else ".".join(parts))
                 yield _finding(
-                    "CHS001", module, call,
-                    f"{'.'.join(parts)}() on a jit-reachable path "
-                    "without a chaos.enabled()/obs.enabled() guard — "
-                    "fault hooks advance seeded RNG streams under the "
-                    "engine lock and recovery telemetry builds event "
-                    "payloads when enabled; gate the call (or hoist "
-                    "it off the traced path)")
+                    spec.rule_id, module, call,
+                    f"{head}() on a jit-reachable path without "
+                    f"{spec.guard_desc} guard — {spec.work_desc}; "
+                    "gate the call (or hoist it off the traced path)")
 
 
-# distinctive bare names for the sync-service layer (PR 12); generic
-# verbs (offer/drain/tick/evict) are matched through the ``serve``
-# module qualifier instead, or they would flag every unrelated queue.
-# The serve package is HOST work by design (admission, journaling,
-# LRU residency, lifecycle) — it takes queue locks, writes the WAL
-# and walks checkpoint packs; none of that belongs inside a traced
-# program even with obs off, so reaching it from jit-reachable code
-# unguarded is a structural smell, not just an overhead one.
-_SERVE_APIS = frozenset(
-    {"IngestQueue", "IngestJournal", "BatchController",
-     "ResidencyManager", "SyncService"}
-)
+def _register_guard_rules() -> None:
+    for spec in _GUARD_RULES:
+        def check(ctx, module, _spec=spec):
+            return _check_guarded_api(_spec, ctx, module)
+        rule(spec.rule_id, spec.help)(check)
 
 
-@rule("SRV001",
-      "sync-service API reached from jit-reachable code without an "
-      "obs.enabled() guard (the serve layer takes admission-queue "
-      "locks, appends to the write-ahead journal and packs/restores "
-      "checkpoint-grade state — host lifecycle work that must never "
-      "sit on a traced path)")
-def check_srv001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module) or "serve" in module.segments:
-        return
-    for info in ctx.reachable_funcs(module):
-        for call, guarded in _calls_with_guards(info):
-            parts = dotted_parts(call.func)
-            if parts is None:
-                continue
-            if _is_enabled_name(parts[-1]):
-                # the sanctioned guard spellings, as in OBS003-007
-                continue
-            is_serve = (
-                parts[-1] in _SERVE_APIS
-                or any(p in ("serve", "_serve") for p in parts[:-1])
-            )
-            if is_serve and not guarded:
-                yield _finding(
-                    "SRV001", module, call,
-                    f"{'.'.join(parts)}() on a jit-reachable path "
-                    "without an obs.enabled() guard — the serve layer "
-                    "takes queue locks, journals admissions and "
-                    "spills/restores checkpoint packs; gate the call "
-                    "(or hoist it off the traced path)")
-
-
-# distinctive bare names for the network-transport layer (PR 13);
-# generic verbs and common helper names (pump/dial/read/write,
-# send_msg/recv_msg) are matched through the ``net``/``transport``
-# module qualifiers instead, or they would flag every socket/IPC
-# helper in the tree. The net package is HOST work by definition — it
-# blocks on sockets, sleeps out backoff ladders and takes connection
-# locks; none of that can ever sit inside a traced program, so
-# reaching it from jit-reachable code unguarded is a structural
-# smell exactly like SRV001's.
-_NET_APIS = frozenset(
-    {"NetClient", "ReplicationServer", "FrameStream", "Backoff",
-     "loopback_pair"}
-)
-
-
-@rule("NET001",
-      "network-transport API reached from jit-reachable code without "
-      "an obs.enabled() guard (the net layer blocks on sockets, "
-      "sleeps out reconnect backoff and takes connection locks — "
-      "host transport work that must never sit on a traced path)")
-def check_net001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module) or "net" in module.segments:
-        return
-    for info in ctx.reachable_funcs(module):
-        for call, guarded in _calls_with_guards(info):
-            parts = dotted_parts(call.func)
-            if parts is None:
-                continue
-            if _is_enabled_name(parts[-1]):
-                # the sanctioned guard spellings, as in OBS003-007
-                continue
-            is_net = (
-                parts[-1] in _NET_APIS
-                or any(p in ("net", "_net", "transport", "_transport")
-                       for p in parts[:-1])
-            )
-            if is_net and not guarded:
-                yield _finding(
-                    "NET001", module, call,
-                    f"{'.'.join(parts)}() on a jit-reachable path "
-                    "without an obs.enabled() guard — the net layer "
-                    "blocks on socket IO, sleeps out backoff ladders "
-                    "and mutates connection state; gate the call (or "
-                    "hoist it off the traced path)")
-
-
-# distinctive bare names for the durable-storage layer (PR 15);
-# generic verbs (append/gc/scan/close) are matched through the
-# ``wal``/``scrub`` module qualifiers instead, or they would flag
-# every list.append in the tree. The WAL/scrubber are HOST storage
-# work by definition — they hold file locks, fsync descriptors and
-# walk whole segment directories; none of that can ever sit inside a
-# traced program, so reaching it from jit-reachable code unguarded is
-# a structural smell exactly like SRV001's/NET001's.
-_DSK_APIS = frozenset(
-    {"WriteAheadLog", "open_journal", "scrub_wal",
-     "scrub_checkpoints", "bench_fsync"}
-)
-
-
-@rule("DSK001",
-      "WAL/scrubber API reached from jit-reachable code without an "
-      "obs.enabled() guard (the durable-storage layer fsyncs file "
-      "descriptors, rotates/retires segment files and walks segment "
-      "directories re-checking CRCs — host storage work that must "
-      "never sit on a traced path)")
-def check_dsk001(ctx: Context, module: ModuleInfo) -> Iterator[Finding]:
-    if _in_obs_package(module) or "serve" in module.segments:
-        return
-    for info in ctx.reachable_funcs(module):
-        for call, guarded in _calls_with_guards(info):
-            parts = dotted_parts(call.func)
-            if parts is None:
-                continue
-            if _is_enabled_name(parts[-1]):
-                # the sanctioned guard spellings, as in OBS003-007
-                continue
-            is_wal = (
-                parts[-1] in _DSK_APIS
-                or any(p in ("wal", "_wal", "scrub", "_scrub")
-                       for p in parts[:-1])
-            )
-            if is_wal and not guarded:
-                yield _finding(
-                    "DSK001", module, call,
-                    f"{'.'.join(parts)}() on a jit-reachable path "
-                    "without an obs.enabled() guard — the durable-"
-                    "storage layer fsyncs descriptors, rotates and "
-                    "retires segment files and re-checks CRCs over "
-                    "whole directories; gate the call (or hoist it "
-                    "off the traced path)")
+_register_guard_rules()
 
 
 # ----------------------------------------------------------------- LCA

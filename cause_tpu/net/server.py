@@ -111,11 +111,21 @@ class ReplicationServer:
             "ooo_frames": 0, "idle_closes": 0, "heartbeats": 0,
             "poison_nacks": 0,
         }
+        self._stats_lock = threading.Lock()
         self._accept_thread: Optional[threading.Thread] = None
+
+    def _bump(self, key: str, n: int = 1) -> None:
+        """Every stats increment funnels through this lock: handler
+        threads race on the counters and the net soak gates EXACT
+        counts, so a lost ``+= 1`` (read-modify-write interleave) is
+        a test failure, not noise. ``_bump`` takes no other lock, so
+        callers may hold ``_wm_lock``/``_conns_lock`` freely."""
+        with self._stats_lock:
+            self.stats[key] += n
 
     # ---------------------------------------------------- watermarks
 
-    def _seed_watermarks(self) -> None:
+    def _seed_watermarks_locked(self) -> None:
         """Seed EVERY tenant's per-site lamport watermark in ONE pass
         over the write-ahead journal — the durable authority for every
         op ever wire-admitted (the restored service replayed it; the
@@ -163,7 +173,7 @@ class ReplicationServer:
             return None
         with self._wm_lock:
             if not self._wm_seeded:
-                self._seed_watermarks()
+                self._seed_watermarks_locked()
             wm = self._wm.get(uuid)
             if wm is None:
                 wm = {}
@@ -212,7 +222,7 @@ class ReplicationServer:
             conn = _Conn(fs, peer=f"{addr[0]}:{addr[1]}")
             with self._conns_lock:
                 self._conns.append(conn)
-                self.stats["connections"] += 1
+                self._bump("connections")
                 n_open = sum(1 for c_ in self._conns
                              if not c_.fs.closed)
             if obs.enabled():
@@ -245,7 +255,7 @@ class ReplicationServer:
                         # a connection with no frames for the whole
                         # idle deadline is dead weight — heartbeats
                         # keep a healthy client well inside it
-                        self.stats["idle_closes"] += 1
+                        self._bump("idle_closes")
                         if obs.enabled():
                             obs.counter("net.idle_closes").inc()
                             obs.event("net.idle_close", peer=conn.peer,
@@ -254,7 +264,7 @@ class ReplicationServer:
                 except OSError:
                     return
                 op = frame.get("op") if isinstance(frame, dict) else None
-                self.stats["frames"] += 1
+                self._bump("frames")
                 try:
                     if op == "hello":
                         reply = self._welcome(conn, frame)
@@ -318,13 +328,13 @@ class ReplicationServer:
         re-done; an older seq is out-of-order — rejected. None means
         the frame is fresh."""
         if seq == conn.last_seq and conn.last_reply is not None:
-            self.stats["dup_frames"] += 1
+            self._bump("dup_frames")
             if obs.enabled():
                 obs.counter("net.dup_frames").inc()
                 obs.event("net.dup_frame", seq=seq, peer=conn.peer)
             return dict(conn.last_reply)
         if seq <= conn.last_seq:
-            self.stats["ooo_frames"] += 1
+            self._bump("ooo_frames")
             if obs.enabled():
                 obs.counter("net.ooo_frames").inc()
                 obs.event("net.ooo_frame", seq=seq,
@@ -337,7 +347,7 @@ class ReplicationServer:
         guarded = self._seq_guard(conn, seq)
         if guarded is not None:
             return guarded
-        self.stats["heartbeats"] += 1
+        self._bump("heartbeats")
         if obs.enabled():
             obs.counter("net.heartbeats").inc()
             obs.event("net.heartbeat", peer=conn.peer, side="server")
@@ -349,7 +359,7 @@ class ReplicationServer:
     def _nack(self, seq: int, reason: str,
               retry_after_ms: Optional[float] = None,
               uuid: str = "", site: str = "") -> dict:
-        self.stats["nacks"] += 1
+        self._bump("nacks")
         reply = {"op": "nack", "seq": seq, "reason": reason}
         if retry_after_ms is not None:
             reply["retry_after_ms"] = retry_after_ms
@@ -396,7 +406,7 @@ class ReplicationServer:
                      "why": "op site != frame site"})
         except s.CausalError as e:
             why = next(iter(e.info.get("causes", ("payload-invalid",))))
-            self.stats["poison_nacks"] += 1
+            self._bump("poison_nacks")
             sync.note_reject(site, uuid=uuid, why=why)
             return finish(self._nack(seq, why, uuid=uuid, site=site))
         # --- idempotent re-delivery: the lamport watermark filter.
@@ -419,14 +429,14 @@ class ReplicationServer:
                     if (int(it[0][0]), int(it[0][2])) > h]
             suppressed = len(items) - len(kept)
             if suppressed:
-                self.stats["dup_ops_suppressed"] += suppressed
+                self._bump("dup_ops_suppressed", suppressed)
                 if obs.enabled():
                     obs.counter("net.dup_suppressed").inc(suppressed)
                     obs.event("net.dup_ops", ops=suppressed,
                               uuid=uuid, site=site, seq=seq)
             if not kept:
                 sync.note_clean(site)
-                self.stats["acks"] += 1
+                self._bump("acks")
                 return finish({"op": "ack", "seq": seq, "admitted": 0,
                                "dup": suppressed})
             adm = self.queue.offer(uuid, site, kept)
@@ -435,8 +445,8 @@ class ReplicationServer:
                 wm[site] = [int(last[0]), int(last[2])]
         if adm.admitted:
             sync.note_clean(site)
-            self.stats["acks"] += 1
-            self.stats["admitted_ops"] += len(kept)
+            self._bump("acks")
+            self._bump("admitted_ops", len(kept))
             if obs.enabled():
                 obs.counter("net.admitted_ops").inc(len(kept))
             return finish({"op": "ack", "seq": seq,

@@ -256,6 +256,10 @@ class NetClient:
             if int(reply.get("seq") or 0) == seq:
                 return reply
             self.stats["stale_replies"] += 1
+        if obs.enabled():
+            obs.counter("net.desyncs").inc()
+            obs.event("net.desync", expected=seq,
+                      drained=_STALE_REPLY_MAX)
         raise s.CausalError(
             "net: reply stream desynced",
             {"causes": {"bad-frame"}, "expected": f"seq {seq}"})

@@ -820,6 +820,12 @@ class FleetSession:
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             f.write(blob)
+            f.flush()
+            # fsync BEFORE the rename: the rename can be durable
+            # while the data is not, publishing a torn checkpoint —
+            # and serve-side storage GC retires WAL segments on the
+            # strength of this file existing
+            os.fsync(f.fileno())
         os.replace(tmp, path)
 
     @classmethod

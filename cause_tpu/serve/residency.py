@@ -196,17 +196,29 @@ class ResidencyManager:
         for uuid, pack in self._spilled.items():
             rel = f"{uuid}.ckpt.json"
             dst = os.path.join(out_dir, rel)
+            # tmp-fd fsync before each rename: post-checkpoint WAL GC
+            # retires segments on the strength of these files, so a
+            # torn pack after a crash is real data loss, not a retry.
+            # The DIRECTORY entries are fsynced once by the caller
+            # (service checkpoint fsync_dir after the manifest swap),
+            # not per file here.
             if isinstance(pack, str):
                 if os.path.abspath(pack) != os.path.abspath(dst):
                     blob = open(pack).read()
                     tmp = f"{dst}.tmp.{os.getpid()}"
                     with open(tmp, "w") as f:
                         f.write(blob)
+                        f.flush()
+                        os.fsync(f.fileno())
+                    # causelint: disable-next-line=DUR002 -- caller fsyncs out_dir once after the manifest swap (one dir fsync per drain, not one per tenant)
                     os.replace(tmp, dst)
             else:
                 tmp = f"{dst}.tmp.{os.getpid()}"
                 with open(tmp, "w") as f:
                     f.write(json.dumps(pack))
+                    f.flush()
+                    os.fsync(f.fileno())
+                # causelint: disable-next-line=DUR002 -- caller fsyncs out_dir once after the manifest swap (one dir fsync per drain, not one per tenant)
                 os.replace(tmp, dst)
             out[uuid] = {"file": rel}
         return out

@@ -121,6 +121,10 @@ class SyncService:
             # silent overwrite cross-wires both tenants' journal
             # watermarks and residency slots (it corrupted the first
             # net soak run). Mint a fresh clist per tenant instead.
+            if obs.enabled():
+                obs.counter("serve.refusals").inc()
+                obs.event("serve.refusal", op="add_tenant",
+                          why="duplicate-tenant", uuid=uuid)
             raise s.CausalError(
                 "serve: duplicate tenant uuid",
                 {"causes": {"duplicate-tenant"}, "uuid": uuid,
@@ -164,6 +168,10 @@ class SyncService:
         are never silently dropped."""
         sess = self.residency.get(uuid)
         if sess is None:
+            if obs.enabled():
+                obs.counter("serve.refusals").inc()
+                obs.event("serve.refusal", op="apply",
+                          why="unknown-tenant", uuid=uuid)
             raise s.CausalError(
                 "serve: batch for unknown tenant",
                 {"causes": {"unknown-tenant"}, "uuid": uuid})
@@ -535,6 +543,7 @@ class SyncService:
             manifest = json.load(f)
         if not (isinstance(manifest, dict)
                 and manifest.get("~serve_manifest") == MANIFEST_VERSION):
+            # causelint: disable-next-line=EVD001 -- restore() runs pre-stream at process start; the raise reaches the operator directly and there is no obs stream to evidence into yet
             raise s.CausalError(
                 "not a serve manifest (or unknown version)",
                 {"causes": {"checkpoint-mismatch"}})

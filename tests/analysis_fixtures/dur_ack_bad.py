@@ -1,0 +1,17 @@
+"""DUR003 shape: an admission path that returns the ack before the
+journal append that records the batch — a crash in between loses an
+acknowledged batch. Parsed by tests, never imported."""
+
+
+class EagerQueue:
+    def __init__(self, journal):
+        self.journal = journal
+        self.depth = 0
+
+    def offer(self, uuid, items):
+        if self.depth < 4:
+            # DUR003: acked, but nothing durable records the batch yet
+            return {"op": "ack", "admitted": len(items)}
+        self.journal.append({"uuid": uuid, "items": items})
+        self.depth += 1
+        return {"op": "ack", "admitted": len(items)}

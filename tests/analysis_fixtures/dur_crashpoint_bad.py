@@ -1,0 +1,20 @@
+"""DUR004 shape: a chaos crash seam firing inside a lock-held region
+— no real process dies holding a released lock, and a stall seam
+there serializes every contending thread. Parsed by tests, never
+imported."""
+
+import threading
+
+from cause_tpu import chaos
+
+
+class RotatingLog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rotations = 0
+
+    def rotate(self):
+        with self._lock:
+            if chaos.should_crash("fixture.rotate"):  # DUR004
+                raise RuntimeError("chaos crash")
+            self.rotations += 1

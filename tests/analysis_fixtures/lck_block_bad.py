@@ -1,0 +1,27 @@
+"""LCK003 shapes: a blocking fsync directly inside the lock region,
+and a lock-held call into a helper that sleeps. Parsed by tests,
+never imported."""
+
+import os
+import threading
+import time
+
+
+class SyncedAppender:
+    def __init__(self, fh):
+        self._lock = threading.Lock()
+        self._fh = fh
+        self.appended = 0
+
+    def append(self, blob):
+        with self._lock:
+            self._fh.write(blob)
+            os.fsync(self._fh.fileno())  # LCK003: IO under the lock
+            self.appended += 1
+
+    def drain(self):
+        with self._lock:
+            self._settle()  # LCK003: helper blocks on sleep
+
+    def _settle(self):
+        time.sleep(0.1)

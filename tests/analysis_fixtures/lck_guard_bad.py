@@ -1,0 +1,29 @@
+"""Seeded historical bug (PR 12): the boundary-reject stats shape —
+a counter dict written under the lock in one thread-reachable method
+and bumped lock-free in another. LCK001 must flag the lock-free bump.
+
+Parsed by tests, never imported.
+"""
+
+import threading
+
+
+class BoundaryServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.stats = {"accepts": 0, "rejects": 0}
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while True:
+            with self._lock:
+                self.stats["accepts"] += 1
+            self._reject()
+
+    def _reject(self):
+        # LCK001: handler-thread write racing the locked writer
+        self.stats["rejects"] += 1

@@ -1,0 +1,35 @@
+"""LCK002 shapes: a two-lock order cycle (credit takes A then B,
+debit takes B then A) and a non-reentrant Lock reacquired through a
+helper call. Parsed by tests, never imported."""
+
+import threading
+
+
+class PairedLedger:
+    def __init__(self):
+        self._alock = threading.Lock()
+        self._block = threading.Lock()
+        self.a = 0
+        self.b = 0
+
+    def start(self):
+        threading.Thread(target=self.credit, daemon=True).start()
+        threading.Thread(target=self.debit, daemon=True).start()
+
+    def credit(self):
+        with self._alock:
+            with self._block:  # LCK002: A -> B ...
+                self.a += 1
+
+    def debit(self):
+        with self._block:
+            with self._alock:  # LCK002: ... while debit orders B -> A
+                self.b += 1
+
+    def reconcile(self):
+        with self._alock:
+            self._settle()  # LCK002: _settle reacquires _alock
+
+    def _settle(self):
+        with self._alock:
+            self.a -= 1

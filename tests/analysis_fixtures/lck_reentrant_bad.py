@@ -1,0 +1,28 @@
+"""Seeded historical bug (PR 15): fsync-failure handling re-entering
+rotation — the seal step is reachable from itself through an error
+path, double-sealing a segment. LCK004 must flag both members of the
+commit cycle. RLock (as in the real WAL) so the reentry is possible
+rather than a self-deadlock. Parsed by tests, never imported."""
+
+import threading
+
+
+class SegmentedLog:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.sealed = 0
+
+    def rotate(self):
+        with self._lock:
+            self._seal_locked()
+
+    def _seal_locked(self):
+        self.sealed += 1
+        try:
+            self._fsync_segment()
+        except OSError:
+            # LCK004: error-path reentry re-runs the seal step
+            self.rotate()
+
+    def _fsync_segment(self):
+        pass

@@ -1,0 +1,34 @@
+"""Seeded historical bug (PR 13): the non-atomic filter -> offer ->
+advance — the watermark is read and seeded under the RLock but
+ADVANCED lock-free after the journal append, so a client reconnecting
+mid-admission reads a stale horizon and double-journals. LCK001 must
+flag the lock-free advance.
+
+Parsed by tests, never imported.
+"""
+
+import threading
+
+
+class AdmissionGate:
+    def __init__(self, journal):
+        self._wm_lock = threading.RLock()
+        self._wm = {}
+        self.journal = journal
+
+    def serve(self):
+        t = threading.Thread(target=self._admit_loop, daemon=True)
+        t.start()
+
+    def _admit_loop(self):
+        while True:
+            self._admit("site-a", [(2, 1)])
+
+    def _admit(self, site, items):
+        with self._wm_lock:
+            horizon = self._wm.setdefault(site, (0, 0))
+            kept = [it for it in items if it > horizon]
+        self.journal.append({"site": site, "items": kept})
+        if kept:
+            # LCK001: the advance escaped the filter's lock region
+            self._wm[site] = kept[-1]

@@ -1,0 +1,15 @@
+"""Seeded historical bug (PR 15 review): a checkpoint pack written
+and renamed into place with no fsync on the tmp fd (DUR001) and no
+directory fsync after the swap (DUR002 — the directory is named
+``serve`` so the wal.fsync_dir idiom applies). Parsed by tests,
+never imported."""
+
+import json
+import os
+
+
+def publish_pack(path, payload):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(payload))
+    os.replace(tmp, path)  # DUR001 + DUR002: no fsync, no fsync_dir

@@ -1,0 +1,17 @@
+"""The full commit idiom: tmp write, fd fsync, atomic rename, parent
+directory fsync. Zero findings. Parsed by tests, never imported."""
+
+import json
+import os
+
+from cause_tpu.serve.wal import fsync_dir
+
+
+def publish_pack(path, payload):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(payload))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    fsync_dir(os.path.dirname(path))

@@ -1,0 +1,13 @@
+"""EVD001 shape: a serve-boundary refusal that emits no obs evidence
+on the path — invisible to the evidence ledger. Parsed by tests,
+never imported."""
+
+from cause_tpu.collections import shared as s
+
+
+def admit(tenants, uuid, items):
+    if uuid not in tenants:
+        # EVD001: refusal with no event/counter anywhere upstream
+        raise s.CausalError(
+            "unknown tenant", {"causes": {"unknown-tenant"}})
+    return {"op": "ack", "admitted": len(items)}

@@ -1,0 +1,16 @@
+"""The evidence contract honored: the refusal path emits a counter
+and an event before raising. Zero findings. Parsed by tests, never
+imported."""
+
+from cause_tpu import obs
+from cause_tpu.collections import shared as s
+
+
+def admit(tenants, uuid, items):
+    if uuid not in tenants:
+        if obs.enabled():
+            obs.counter("fixture.refusals").inc()
+            obs.event("fixture.refusal", uuid=uuid)
+        raise s.CausalError(
+            "unknown tenant", {"causes": {"unknown-tenant"}})
+    return {"op": "ack", "admitted": len(items)}

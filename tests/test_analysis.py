@@ -28,9 +28,12 @@ def run_api(*paths, root=REPO):
 
 
 def run_cli(*args, cwd=REPO):
+    env = dict(os.environ)
+    if os.path.abspath(cwd) != REPO:  # keep cause_tpu importable
+        env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     return subprocess.run(
         [sys.executable, "-m", "cause_tpu.analysis", *args],
-        capture_output=True, text=True, cwd=cwd, timeout=120,
+        capture_output=True, text=True, cwd=cwd, timeout=120, env=env,
     )
 
 
@@ -243,6 +246,123 @@ def test_wal_unguarded_call_on_traced_path():
     assert rules_of(res) == ["DSK001"]
 
 
+def test_lck_guard_bad_fixture():
+    """LCK001 (PR 17), seeded historical bug: PR 12's boundary-reject
+    stats — written under the lock in the spawning thread's loop,
+    bumped lock-free in a thread-reachable helper. Exactly one
+    finding: the lock-free bump (the locked write and the dunder
+    __init__ stores are sanctioned)."""
+    res = run_api(os.path.join(FIX, "lck_guard_bad.py"))
+    lck = [f for f in res.findings if f.rule == "LCK001"]
+    assert len(lck) == 1, [f.message for f in lck]
+    assert "self.stats" in lck[0].message
+    assert "BoundaryServer._reject" in lck[0].message
+    assert rules_of(res) == ["LCK001"]
+
+
+def test_lck_watermark_bad_fixture():
+    """LCK001 (PR 17), seeded historical bug: PR 13's non-atomic
+    filter -> offer -> advance — the watermark seeded under the RLock
+    but advanced lock-free after the journal append. Exactly one
+    finding: the escaped advance."""
+    res = run_api(os.path.join(FIX, "lck_watermark_bad.py"))
+    lck = [f for f in res.findings if f.rule == "LCK001"]
+    assert len(lck) == 1, [f.message for f in lck]
+    assert "self._wm" in lck[0].message
+    assert "_wm_lock" in lck[0].message
+    assert rules_of(res) == ["LCK001"]
+
+
+def test_lck_order_bad_fixture():
+    """LCK002 (PR 17): both edges of the A->B / B->A order cycle flag
+    (each side is one deadlock half), plus the reacquisition of a
+    non-reentrant Lock through a resolved helper call."""
+    res = run_api(os.path.join(FIX, "lck_order_bad.py"))
+    lck = [f for f in res.findings if f.rule == "LCK002"]
+    assert len(lck) == 3, [f.message for f in lck]
+    assert sum("lock-order cycle" in f.message for f in lck) == 2
+    reacq = [f for f in lck if "reacquisition" in f.message]
+    assert len(reacq) == 1 and "_settle" in reacq[0].message
+    assert rules_of(res) == ["LCK002"]
+
+
+def test_lck_block_bad_fixture():
+    """LCK003 (PR 17): a direct os.fsync inside the lock region and a
+    lock-held call into a helper that sleeps — both flagged, with the
+    blocking op named."""
+    res = run_api(os.path.join(FIX, "lck_block_bad.py"))
+    lck = [f for f in res.findings if f.rule == "LCK003"]
+    assert len(lck) == 2, [f.message for f in lck]
+    assert "fsync" in lck[0].message
+    assert "_settle" in lck[1].message and "sleep" in lck[1].message
+    assert rules_of(res) == ["LCK003"]
+
+
+def test_lck_reentrant_bad_fixture():
+    """LCK004 (PR 17), seeded historical bug: PR 15's fsync-failure
+    reentrancy — the seal step reachable from itself through an error
+    path. Both members of the commit cycle flag, naming the cycle."""
+    res = run_api(os.path.join(FIX, "lck_reentrant_bad.py"))
+    lck = [f for f in res.findings if f.rule == "LCK004"]
+    assert len(lck) == 2, [f.message for f in lck]
+    assert all("error path" in f.message for f in lck)
+    assert all("_seal_locked" in f.message for f in lck)
+    assert rules_of(res) == ["LCK004"]
+
+
+def test_dur_rename_bad_fixture():
+    """DUR001/DUR002 (PR 17), seeded historical bug: PR 15 review's
+    missing tmp-fsync before the atomic rename, plus the missing
+    directory fsync after it (the fixture lives under a ``serve``
+    directory so the wal.fsync_dir idiom applies)."""
+    res = run_api(os.path.join(FIX, "serve", "dur_rename_bad.py"))
+    assert rules_of(res) == ["DUR001", "DUR002"]
+    d1 = [f for f in res.findings if f.rule == "DUR001"]
+    assert len(d1) == 1 and "torn" in d1[0].message
+
+
+def test_dur_rename_good_fixture_is_clean():
+    res = run_api(os.path.join(FIX, "serve", "dur_rename_good.py"))
+    assert res.findings == []
+
+
+def test_dur_ack_bad_fixture():
+    """DUR003 (PR 17): the ack returned lexically before the journal
+    append that records the batch — exactly the early return flags,
+    the post-append ack is sanctioned."""
+    res = run_api(os.path.join(FIX, "dur_ack_bad.py"))
+    dur = [f for f in res.findings if f.rule == "DUR003"]
+    assert len(dur) == 1, [f.message for f in dur]
+    assert "journal-before-ack" in dur[0].message
+    assert rules_of(res) == ["DUR003"]
+
+
+def test_dur_crashpoint_bad_fixture():
+    """DUR004 (PR 17): a chaos crash seam firing while the lock is
+    held — the simulated failure matches no real process death."""
+    res = run_api(os.path.join(FIX, "dur_crashpoint_bad.py"))
+    dur = [f for f in res.findings if f.rule == "DUR004"]
+    assert len(dur) == 1, [f.message for f in dur]
+    assert "should_crash" in dur[0].message
+    assert rules_of(res) == ["DUR004"]
+
+
+def test_evd_bad_fixture():
+    """EVD001 (PR 17): a serve-boundary raise with no obs evidence on
+    the path flags; the twin fixture that counters + events first is
+    clean."""
+    res = run_api(os.path.join(FIX, "serve", "evd_bad.py"))
+    evd = [f for f in res.findings if f.rule == "EVD001"]
+    assert len(evd) == 1, [f.message for f in evd]
+    assert "raise CausalError" in evd[0].message
+    assert rules_of(res) == ["EVD001"]
+
+
+def test_evd_good_fixture_is_clean():
+    res = run_api(os.path.join(FIX, "serve", "evd_good.py"))
+    assert res.findings == []
+
+
 def test_lca_bad_fixture():
     res = run_api(os.path.join(FIX, "lca_bad.py"))
     lca = [f for f in res.findings if f.rule == "LCA001"]
@@ -360,6 +480,11 @@ def test_cli_exit_codes():
     "lag_caller_bad.py", "live_caller_bad.py",
     "chaos_caller_bad.py", "serve_caller_bad.py", "net_caller_bad.py",
     "wal_caller_bad.py", "lca_bad.py",
+    "lck_guard_bad.py", "lck_watermark_bad.py", "lck_order_bad.py",
+    "lck_block_bad.py", "lck_reentrant_bad.py", "dur_ack_bad.py",
+    "dur_crashpoint_bad.py",
+    os.path.join("serve", "dur_rename_bad.py"),
+    os.path.join("serve", "evd_bad.py"),
 ])
 def test_cli_gates_each_known_bad_fixture(fixture):
     assert run_cli(os.path.join(FIX, fixture)).returncode == 1
@@ -371,7 +496,9 @@ def test_cli_list_rules():
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
                 "OBS006", "OBS007", "CHS001", "SRV001", "NET001",
-                "DSK001", "LCA001", "GEN001"):
+                "DSK001", "LCA001", "GEN001", "LCK001", "LCK002",
+                "LCK003", "LCK004", "DUR001", "DUR002", "DUR003",
+                "DUR004", "EVD001"):
         assert rid in out.stdout
 
 
@@ -402,6 +529,134 @@ def test_cli_works_without_jax_or_numpy(tmp_path):
                          timeout=120)
     assert out.returncode == 0, out.stderr
     assert "0 finding(s)" in out.stdout
+
+
+# --------------------------------------------- incremental cache mode
+
+def _write_flagged(path):
+    """A module with one deterministic, file-local finding (GEN001)."""
+    path.write_text("def broken(:\n")
+
+
+def test_cache_warm_hit_replays_without_reanalyzing(tmp_path):
+    mod = tmp_path / "mod.py"
+    cache = tmp_path / "cache.json"
+    _write_flagged(mod)
+    first = core.cached_run([str(mod)], root=str(tmp_path),
+                            cache_path=str(cache))
+    assert rules_of(first) == ["GEN001"]
+    # tamper with the cached verdict but leave the key fields intact:
+    # a warm hit must replay the (tampered) payload verbatim, proving
+    # the second run never re-analyzed the file
+    payload = json.loads(cache.read_text())
+    payload["findings"][0][4] = "TAMPERED-SENTINEL"
+    cache.write_text(json.dumps(payload))
+    second = core.cached_run([str(mod)], root=str(tmp_path),
+                             cache_path=str(cache))
+    assert second.findings[0].message == "TAMPERED-SENTINEL"
+
+
+def test_cache_invalidates_on_content_change(tmp_path):
+    mod = tmp_path / "mod.py"
+    cache = tmp_path / "cache.json"
+    _write_flagged(mod)
+    assert core.cached_run([str(mod)], root=str(tmp_path),
+                           cache_path=str(cache)).exit_code == 1
+    mod.write_text("def fixed():\n    return 1\n")
+    res = core.cached_run([str(mod)], root=str(tmp_path),
+                          cache_path=str(cache))
+    assert res.findings == [] and res.exit_code == 0
+    # and the cache now records the clean verdict for the new hash
+    assert json.loads(cache.read_text())["findings"] == []
+
+
+def test_cache_invalidates_on_ruleset_version_bump(tmp_path):
+    mod = tmp_path / "mod.py"
+    cache = tmp_path / "cache.json"
+    _write_flagged(mod)
+    core.cached_run([str(mod)], root=str(tmp_path),
+                    cache_path=str(cache))
+    # simulate a cache written by an older analyzer: same hashes,
+    # stale rule-set version, poisoned verdict
+    payload = json.loads(cache.read_text())
+    payload["ruleset"] = payload["ruleset"] - 1
+    payload["findings"] = []
+    cache.write_text(json.dumps(payload))
+    res = core.cached_run([str(mod)], root=str(tmp_path),
+                          cache_path=str(cache))
+    assert rules_of(res) == ["GEN001"]  # re-analyzed, not replayed
+    refreshed = json.loads(cache.read_text())
+    from cause_tpu.analysis.rules import RULESET_VERSION
+    assert refreshed["ruleset"] == RULESET_VERSION
+
+
+def test_cache_keyed_on_rule_selection(tmp_path):
+    mod = tmp_path / "mod.py"
+    cache = tmp_path / "cache.json"
+    _write_flagged(mod)
+    full = core.cached_run([str(mod)], root=str(tmp_path),
+                           cache_path=str(cache))
+    assert rules_of(full) == ["GEN001"]
+    # poison the full-run verdict: a different rule selection keys
+    # differently, so it must re-analyze instead of replaying this
+    payload = json.loads(cache.read_text())
+    payload["findings"][0][4] = "TAMPERED-SENTINEL"
+    cache.write_text(json.dumps(payload))
+    sub = core.cached_run([str(mod)], root=str(tmp_path),
+                          rule_ids=["TID001"], cache_path=str(cache))
+    assert sub.findings and sub.findings[0].message != "TAMPERED-SENTINEL"
+
+
+def test_corrupt_cache_falls_back_to_analysis(tmp_path):
+    mod = tmp_path / "mod.py"
+    cache = tmp_path / "cache.json"
+    _write_flagged(mod)
+    cache.write_text("{not json")
+    res = core.cached_run([str(mod)], root=str(tmp_path),
+                          cache_path=str(cache))
+    assert rules_of(res) == ["GEN001"]
+
+
+def _git(cwd, *args):
+    out = subprocess.run(
+        ["git", "-c", "user.email=t@t", "-c", "user.name=t", *args],
+        capture_output=True, text=True, cwd=cwd, timeout=60)
+    assert out.returncode == 0, out.stderr
+    return out
+
+
+def test_changed_mode_reports_only_diffed_files(tmp_path):
+    _write_flagged(tmp_path / "a.py")
+    _write_flagged(tmp_path / "b.py")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "-A")
+    _git(tmp_path, "commit", "-qm", "seed")
+
+    # nothing changed yet: fast exit 0, even though both files have
+    # findings a full run would gate on
+    out = run_cli("--changed", "HEAD", ".", cwd=str(tmp_path))
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "no analyzed files changed" in out.stdout
+
+    # touch b.py (still flagged) and add an untracked c.py: both are
+    # reported, the unchanged a.py is filtered from the report
+    (tmp_path / "b.py").write_text("def broken(:  # still\n")
+    _write_flagged(tmp_path / "c.py")
+    out = run_cli("--changed", "HEAD", "--format", "json", ".",
+                  cwd=str(tmp_path))
+    assert out.returncode == 1
+    data = json.loads(out.stdout)
+    flagged = sorted(os.path.basename(f["path"])
+                     for f in data["findings"])
+    assert flagged == ["b.py", "c.py"]
+
+
+def test_changed_mode_with_bad_ref_runs_full(tmp_path):
+    _write_flagged(tmp_path / "a.py")
+    _git(tmp_path, "init", "-q")
+    out = run_cli("--changed", "no-such-ref", ".", cwd=str(tmp_path))
+    assert out.returncode == 1
+    assert "running the full analysis" in out.stderr
 
 
 # ----------------------------------------------------------- baseline
@@ -466,8 +721,11 @@ def test_shipped_tree_has_zero_findings():
                     os.path.join(REPO, "bench.py")], root=REPO)
     assert res.findings == [], [
         f"{f.path}:{f.line} {f.rule} {f.message}" for f in res.findings]
-    # the recorded exceptions all carry a reason string
-    assert len(res.suppressed) >= 9
+    # the recorded exceptions all carry a reason string (the PR-17
+    # LCK/DUR/EVD triage added six: wal close-fsync + gc seam, the
+    # native build lock, residency's caller-fsynced dir swaps, and
+    # the pre-stream restore raise)
+    assert len(res.suppressed) >= 15
 
 
 def test_syntax_error_becomes_gen_finding(tmp_path):

@@ -618,3 +618,35 @@ def test_watch_renders_net_line_and_prometheus(tmp_path):
     prom = watch.prometheus_text(snap)
     assert "cause_tpu_live_net_reconnects_total 1" in prom
     assert "cause_tpu_live_net_outbound_depth 3" in prom
+
+
+def test_server_stats_increments_are_lock_safe():
+    """PR-17 regression (the PR-12 shape, re-found by causelint's
+    LCK001 on arrival): handler threads bumped ``stats`` counters
+    lock-free while the accept loop wrote them under ``_conns_lock``,
+    so concurrent read-modify-write interleaves could lose counts the
+    net soak gates exactly. Every increment now funnels through
+    ``_bump`` under a dedicated stats lock: N threads x M bumps must
+    land exactly N*M."""
+    import sys
+    import threading
+
+    srv = ReplicationServer.__new__(ReplicationServer)
+    srv.stats = {"frames": 0}
+    srv._stats_lock = threading.Lock()
+    n_threads, n_bumps = 8, 2000
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)  # force aggressive preemption
+    try:
+        def hammer():
+            for _ in range(n_bumps):
+                srv._bump("frames")
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert srv.stats["frames"] == n_threads * n_bumps
