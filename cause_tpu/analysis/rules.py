@@ -77,7 +77,7 @@ REGISTRY: dict = {}
 # Bumped whenever rule logic or the rule set changes; the incremental
 # cache (core.cached_run) keys on it so a rule-set change invalidates
 # every cached verdict even when no analyzed file changed.
-RULESET_VERSION = 2
+RULESET_VERSION = 3  # PR 18: SRV001 covers the batch-scheduler APIs
 
 
 def rule(rule_id: str, help_text: str):
@@ -745,7 +745,12 @@ _GUARD_RULES = (
         "checkpoint-grade state — host lifecycle work that must "
         "never sit on a traced path)",
         frozenset({"IngestQueue", "IngestJournal", "BatchController",
-                   "ResidencyManager", "SyncService"}),
+                   "ResidencyManager", "SyncService",
+                   # PR 18: the cross-tenant batch scheduler marshals
+                   # heterogeneous window packs and walks per-tenant
+                   # frontiers on the host before its one fused
+                   # dispatch — same never-on-a-traced-path contract
+                   "BatchScheduler", "wave_fleet"}),
         frozenset({"serve", "_serve"}),
         lambda module: "serve" in module.segments,
         "an obs.enabled()",

@@ -207,7 +207,10 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
               delta_ops: int = 0, full_bag: int = 0,
               poisoned: int = 0, overflow_retries: int = 0,
               semantic: Optional[dict] = None,
-              path: str = "", level: Optional[int] = None) -> Optional[dict]:
+              path: str = "", level: Optional[int] = None,
+              bucket: Optional[int] = None,
+              batch_rows: Optional[int] = None,
+              uuids: Optional[Sequence[str]] = None) -> Optional[dict]:
     """Close the open wave window and emit ONE ``wave.cost`` event —
     the per-wave join of cost and divergence:
 
@@ -233,7 +236,14 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
     - ``level``: the merge-tree round this wave IS, when the wave is
       one level of a ``parallel.tree`` reduction — joined with the
       ``tree.level`` semantic events into the gap report's per-level
-      cost decomposition.
+      cost decomposition;
+    - ``bucket`` / ``batch_rows``: cross-tenant batched serving — the
+      pow2 window budget this dispatch's rows shared and how many
+      rows rode it, so the gap report and the live fold can attribute
+      the dispatch-count collapse (one floor per BUCKET, not per
+      tenant). ``uuids`` lists every document the bucket served:
+      their :func:`note_delta_ops` accumulations all drain into this
+      one event instead of dangling.
 
     Returns the emitted fields (or None when obs is off / no window).
     """
@@ -245,9 +255,10 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
         return None
     wall_ms = (time.perf_counter() - w["t0"]) * 1000.0
     u = str(uuid)
+    drain = [u] + [str(x) for x in (uuids or ()) if str(x) != u]
     with _LOCK:
-        pend_ops = _PENDING_OPS.pop(u, 0)
-        pend_bags = _PENDING_BAGS.pop(u, 0)
+        pend_ops = sum(_PENDING_OPS.pop(x, 0) for x in drain)
+        pend_bags = sum(_PENDING_BAGS.pop(x, 0) for x in drain)
         devprof_sum: Dict[str, float] = {}
         for p in w["programs"]:
             for k, v in (_PROGRAMS.get(p) or {}).items():
@@ -273,6 +284,12 @@ def wave_cost(uuid: str = "", pairs: int = 0, lanes: int = 0,
         fields["path"] = str(path)
     if level is not None:
         fields["level"] = int(level)
+    if bucket is not None:
+        fields["bucket"] = int(bucket)
+    if batch_rows is not None:
+        fields["batch_rows"] = int(batch_rows)
+    if uuids is not None:
+        fields["tenants"] = len(uuids)
     if tokens is not None:
         fields["tokens"] = int(tokens)
         fields["token_budget"] = int(token_budget)

@@ -169,6 +169,15 @@ class FleetSession:
 
     _DELTA_FAILURE_LIMIT = 3
 
+    # Batched-serving state (see window_pack/complete_window): the
+    # last bucket dispatch's unspliced window output, the deferred
+    # device-lane mode flag, and whether the resident lanes are behind
+    # the host views. Class-level defaults so restore()d and
+    # pre-existing pickled sessions get the unbatched behavior.
+    _pending_window = None
+    _dev_stale = False
+    defer_device = False
+
     # ------------------------------------------------------------------
     def _collect_views(self, pairs):
         views = []
@@ -243,6 +252,8 @@ class FleetSession:
         self._last_delta_lanes = 0
         self._last_update_full = True
         self._delta = None
+        self._dev_stale = False
+        self._pending_window = None
         if obs.enabled():
             from ..obs import devprof
 
@@ -292,9 +303,6 @@ class FleetSession:
         d_max = self.d_max
         starts = np.zeros((B, 2), np.int32)
         counts = np.zeros((B, 2), np.int32)
-        deltas = {c: np.full((B, 2, d_max), _PAD[c],
-                             self.dev[c].dtype if c != "valid" else bool)
-                  for c in _LANE_COLS}
         tables = {k: [] for k in SEG_LANE_KEYS}
         b_shift = np.zeros(B, np.int32)
         old_nb = np.zeros(B, np.int32)
@@ -333,6 +341,60 @@ class FleetSession:
             raise next(iter(_bad.values()))
         obs.counter("session.delta_update").inc()
 
+        if self._delta is not None:
+            # delta-WAVE domain (stricter than the lane-splice domain
+            # above): every appended lane must weave strictly after the
+            # frozen resident prefix — causes inside the divergent
+            # window or on the anchor, no tombstone of the anchor, and
+            # the window must fit the session's compiled budget. A
+            # violation only drops the delta-wave capability (the next
+            # wave runs full width and re-establishes); the resident
+            # lane splice stays valid either way.
+            dstate = self._delta
+            w_cap = dstate["w_cap"]
+            for r, (va, vb) in enumerate(views):
+                sp = int(dstate["s"][r])
+                anchor = int(dstate["anchor"][r])
+                ok = True
+                for t, v in enumerate((va, vb)):
+                    if v.n - sp > w_cap - 1:
+                        ok = False  # window outgrew the budget
+                        break
+                    if not delta_domain_ok(
+                            v, sp, anchor,
+                            start=int(self._uploaded_n[r, t])):
+                        ok = False
+                        break
+                if not ok:
+                    obs.counter("session.delta_wave_invalidate").inc()
+                    if obs.enabled():
+                        # the splice stays valid; only the delta-WAVE
+                        # capability drops — the next wave runs the
+                        # full rung and re-establishes
+                        _recovery.step(
+                            "session", "delta", "full",
+                            "domain-violation",
+                            uuid=str(pairs[0][0].ct.uuid), pair=r)
+                    self._delta = None
+                    break
+
+        # Batched serving defers the resident lane splice: with a live
+        # frontier the delta wave assembles its window from host views
+        # only, so the device lanes can stay behind until the next
+        # full-width wave (which re-uploads). Without a live frontier
+        # the next wave is full-width and needs current lanes — stale
+        # residents take the declared full-upload rung instead of a
+        # splice onto lanes that no longer match the bookkeeping.
+        defer = self.defer_device and self._delta is not None
+        if not defer and self._dev_stale:
+            return self._degrade(pairs, "stale-resident-lanes")
+        deltas = None
+        if not defer:
+            deltas = {c: np.full((B, 2, d_max), _PAD[c],
+                                 self.dev[c].dtype
+                                 if c != "valid" else bool)
+                      for c in _LANE_COLS}
+
         for r, ((va, vb), _old) in enumerate(zip(views, self._views)):
             segs_a, segs_b = va.segments(), vb.segments()
             ka = int(segs_a["sg_len"].shape[0])
@@ -345,7 +407,7 @@ class FleetSession:
                 d = v.n - n0
                 starts[r, t] = n0
                 counts[r, t] = d
-                if d:
+                if d and not defer:
                     sl = slice(n0, v.n)
                     deltas["hi"][r, t, :d] = a.ts[sl]
                     deltas["lo"][r, t, :d] = a.spec.pack_lo(
@@ -366,71 +428,43 @@ class FleetSession:
             self._uploaded_rol[r] = (
                 segs_a["run_of_lane"], segs_b["run_of_lane"]
             )
-            # small per-row tables, rebuilt host-side every wave via the
-            # shared layout helper
-            row, _bases = concat_seg_tables(
-                [(segs_a, int(self._uploaded_n[r, 0])),
-                 (segs_b, int(self._uploaded_n[r, 1]))],
-                cap, s_max,
+            if not defer:
+                # small per-row tables, rebuilt host-side every wave
+                # via the shared layout helper
+                row, _bases = concat_seg_tables(
+                    [(segs_a, int(self._uploaded_n[r, 0])),
+                     (segs_b, int(self._uploaded_n[r, 1]))],
+                    cap, s_max,
+                )
+                for k in SEG_LANE_KEYS:
+                    tables[k].append(row[k])
+
+        if defer:
+            # batched serving: the delta wave assembles its window
+            # from host views, so the resident lanes stay behind until
+            # the next full-width wave re-uploads (see _full_wave)
+            self._dev_stale = True
+        else:
+            self.dev = _apply_deltas(
+                self.dev,
+                {c: jnp.asarray(deltas[c]) for c in _LANE_COLS},
+                jnp.asarray(starts), jnp.asarray(counts),
+                jnp.asarray(b_shift), jnp.asarray(old_nb),
             )
+            if obs.enabled():
+                # the resident-splice program is a device dispatch too
+                # — it runs outside any wave window (update-time), so
+                # it counts globally; the spliced lane total is the
+                # wave's measured divergence and rides the NEXT
+                # wave.cost
+                from ..obs import costmodel as _cm
+
+                _cm.record_dispatch(f"session:splice:d{self.d_max}",
+                                    site="session")
             for k in SEG_LANE_KEYS:
-                tables[k].append(row[k])
-
-        if self._delta is not None:
-            # delta-WAVE domain (stricter than the lane-splice domain
-            # above): every appended lane must weave strictly after the
-            # frozen resident prefix — causes inside the divergent
-            # window or on the anchor, no tombstone of the anchor, and
-            # the window must fit the session's compiled budget. A
-            # violation only drops the delta-wave capability (the next
-            # wave runs full width and re-establishes); the resident
-            # lane splice above stays valid either way.
-            dstate = self._delta
-            w_cap = dstate["w_cap"]
-            for r, (va, vb) in enumerate(views):
-                sp = int(dstate["s"][r])
-                anchor = int(dstate["anchor"][r])
-                ok = True
-                for t, v in enumerate((va, vb)):
-                    if v.n - sp > w_cap - 1:
-                        ok = False  # window outgrew the budget
-                        break
-                    if not delta_domain_ok(v, sp, anchor,
-                                           start=int(starts[r, t])):
-                        ok = False
-                        break
-                if not ok:
-                    obs.counter("session.delta_wave_invalidate").inc()
-                    if obs.enabled():
-                        # the splice stays valid; only the delta-WAVE
-                        # capability drops — the next wave runs the
-                        # full rung and re-establishes
-                        _recovery.step(
-                            "session", "delta", "full",
-                            "domain-violation",
-                            uuid=str(pairs[0][0].ct.uuid), pair=r)
-                    self._delta = None
-                    break
-
-        self.dev = _apply_deltas(
-            self.dev,
-            {c: jnp.asarray(deltas[c]) for c in _LANE_COLS},
-            jnp.asarray(starts), jnp.asarray(counts),
-            jnp.asarray(b_shift), jnp.asarray(old_nb),
-        )
-        if obs.enabled():
-            # the resident-splice program is a device dispatch too —
-            # it runs outside any wave window (update-time), so it
-            # counts globally; the spliced lane total is the wave's
-            # measured divergence and rides the NEXT wave.cost
-            from ..obs import costmodel as _cm
-
-            _cm.record_dispatch(f"session:splice:d{self.d_max}",
-                                site="session")
+                self.dev[k] = jnp.asarray(np.stack(tables[k]))
         self._last_delta_lanes = int(counts.sum())
         self._last_update_full = False
-        for k in SEG_LANE_KEYS:
-            self.dev[k] = jnp.asarray(np.stack(tables[k]))
         self._views = views
         self.pairs = pairs
 
@@ -476,6 +510,13 @@ class FleetSession:
         from ..benchgen import LANE_KEYS5
         from ..weaver.jaxw5 import batched_merge_weave_v5
 
+        # a full wave recomputes every lane's rank, superseding any
+        # unspliced window output; and it reads the resident lanes, so
+        # a deferred-splice session re-uploads from the current views
+        # first (the O(doc) cost the batched path deferred)
+        self._pending_window = None
+        if self._dev_stale:
+            self._full_upload(self.pairs)
         if obs.enabled():
             from ..obs import costmodel as _cm
 
@@ -659,6 +700,10 @@ class FleetSession:
         wcap = dstate["w_cap"]
         n_w = 2 * wcap
         B = len(self.pairs)
+        # this wave's window covers a superset of any pending one's
+        # lanes (same frontier, counts grow monotonically), so its
+        # splice below supersedes the unflushed output bit-for-bit
+        self._pending_window = None
         if obs.enabled():
             from ..obs import costmodel as _cm
 
@@ -727,6 +772,124 @@ class FleetSession:
         self._last_digest = out
         return out
 
+    # ------------------------------------------ batched-serving hooks
+    #
+    # The assemble→dispatch→splice pipeline of _delta_wave, factored
+    # so an external scheduler (serve.batch.BatchScheduler) can stack
+    # MANY sessions' windows as rows of ONE device program per pow2
+    # bucket: window_pack() hands out the host-side window spec,
+    # complete_window() absorbs this session's rows of the bucket
+    # dispatch's output, and the rank/visibility splice is deferred
+    # (_flush_window) until something actually reads the resident
+    # weave — so N tenants' waves cost one dispatch per bucket, not
+    # three per tenant.
+
+    @property
+    def bucket_key(self) -> int:
+        """The pow2 batch-bucket key: the established window budget,
+        or 0 when the next wave must run full width (no frontier)."""
+        return int(self._delta["w_cap"]) if self._delta is not None \
+            else 0
+
+    def window_pack(self):
+        """The host-side delta-window spec _delta_wave would assemble,
+        for an external batch scheduler: the current views, the frozen
+        frontier arrays, and the pow2 window budget (the bucket key).
+        None when no frontier is established — the caller falls back
+        to :meth:`wave` (full-width re-establish)."""
+        if self._delta is None:
+            return None
+        dstate = self._delta
+        return {
+            "views": self._views,
+            "s": dstate["s"],
+            "anchor": dstate["anchor"],
+            "prefix_digest": dstate["prefix_digest"],
+            "w_cap": int(dstate["w_cap"]),
+            "rows": len(self.pairs),
+        }
+
+    def abandon_frontier(self, reason: str, site: str = "serve"):
+        """Drop the delta frontier with recovery-ladder evidence: the
+        batched scheduler's per-tenant fallback rung (bucket window
+        overflow, injected budget exhaustion). The next wave runs full
+        width and re-establishes — this tenant alone pays the slow
+        path, its bucket-mates stay fast."""
+        if self._delta is None:
+            return
+        obs.counter("session.delta_wave_invalidate").inc()
+        if obs.enabled():
+            _recovery.step(site, "batch", "full", reason,
+                           uuid=str(self.pairs[0][0].ct.uuid))
+        self._delta = None
+
+    def complete_window(self, rank_w, vis_w, digest, starts, counts):
+        """Absorb this session's rows of a bucket dispatch's output
+        (host arrays, already fetched once for the whole bucket). The
+        digests are bit-identical to what _delta_wave would have
+        returned — same window assembly, same program, same budget —
+        so they become the checkpointable wave output directly; the
+        rank/visibility splice is deferred to :meth:`_flush_window`
+        (checkpoint/merged) because the next wave's window covers a
+        superset of these lanes anyway."""
+        dstate = self._delta
+        if dstate is None:
+            raise s.CausalError(
+                "complete_window without an established frontier",
+                {"causes": {"no-frontier"}},
+            )
+        out = np.asarray(digest)
+        self._pending_window = {
+            "rank_w": np.asarray(rank_w),
+            "vis_w": np.asarray(vis_w),
+            "starts": np.asarray(starts, np.int32),
+            "counts": np.asarray(counts, np.int32),
+            "r0": dstate["s"].astype(np.int32) - 1,
+        }
+        if obs.enabled():
+            # per-tenant semantics are unchanged by batching: the
+            # wave.digest agreement, staleness and lag resolution all
+            # observe THIS session's digests, same as _delta_wave
+            _observe_semantics(self.pairs, out,
+                               np.ones(len(self.pairs), bool),
+                               "session")
+        self._last_digest = out
+        return out
+
+    def _flush_window(self):
+        """Splice the pending window output into the resident
+        rank/visibility arrays. Deferred from complete_window: in the
+        batched steady state N waves pass between materializations,
+        and each window supersedes the last, so the splice runs once
+        per read instead of once per wave."""
+        pw = self._pending_window
+        if pw is None:
+            return
+        self._pending_window = None
+        from ..weaver import jaxwd
+
+        self.last_rank, self.last_visible = jaxwd.splice_ranks(
+            self.last_rank, self.last_visible,
+            jnp.asarray(pw["rank_w"]), jnp.asarray(pw["vis_w"]),
+            jnp.asarray(pw["starts"]), jnp.asarray(pw["counts"]),
+            jnp.asarray(pw["r0"]))
+        if obs.enabled():
+            from ..obs import costmodel as _cm
+
+            _cm.record_dispatch("session:delta_splice",
+                                site="session")
+
+    def pop_divergence(self):
+        """(delta_lanes, full_bag) shipped since the last wave — the
+        wave.cost divergence evidence, reset on read. The batched
+        scheduler drains every bucket member and sums them onto the
+        bucket's single wave.cost event."""
+        d = int(self._last_delta_lanes)
+        f = 1 if self._last_update_full else 0
+        self._last_delta_lanes = 0
+        self._last_update_full = False
+        return d, f
+
     def converge(self, tree: bool = True,
                  w_budget: Optional[int] = None):
         """Converge the WHOLE resident fleet — every replica of every
@@ -755,6 +918,7 @@ class FleetSession:
         the last wave."""
         from .wave import WaveResult
 
+        self._flush_window()
         res = WaveResult(
             self.pairs, self._views, self.capacity,
             np.asarray(self.last_rank), np.asarray(self.last_visible),
@@ -785,6 +949,7 @@ class FleetSession:
                 "the last wave also invalidates it)",
                 {"causes": {"no-wave"}},
             )
+        self._flush_window()
         with obs.span("session.checkpoint", pairs=len(self.pairs)):
             obs.counter("session.checkpoint").inc()
             ck = {

@@ -63,6 +63,7 @@ from .wal import WriteAheadLog, open_journal
 __all__ = [
     "Admission",
     "BatchController",
+    "BatchScheduler",
     "IngestJournal",
     "IngestQueue",
     "ResidencyManager",
@@ -82,6 +83,12 @@ def __getattr__(name):
         from .residency import ResidencyManager
 
         return ResidencyManager
+    if name in ("BatchScheduler",):
+        # the cross-tenant batch scheduler dispatches device programs
+        # (jax-backed) — same lazy rule as the session machinery
+        from .batch import BatchScheduler
+
+        return BatchScheduler
     if name in ("SyncService", "ServiceCrashed"):
         from . import service as _service
 

@@ -46,6 +46,11 @@ class ResidencyManager:
     service's tick loop owns it); the soak's generator threads never
     touch residency directly."""
 
+    # the owning service's batched-tick mode: every inserted/restored
+    # session is marked for the deferred-splice path so a restored
+    # tenant rejoins its bucket instead of paying per-tenant splices
+    batched = False
+
     def __init__(self, capacity: int, spill_dir: Optional[str] = None):
         if capacity < 1:
             raise ValueError("capacity must be >= 1")
@@ -72,6 +77,20 @@ class ResidencyManager:
     def __contains__(self, uuid: str) -> bool:
         return uuid in self._resident or uuid in self._spilled
 
+    def buckets(self) -> Dict[int, List[str]]:
+        """Resident tenants grouped by their pow2 batch-bucket key
+        (``FleetSession.bucket_key``; 0 = next wave runs full width).
+        The batched tick's marshaling unit: every tenant under one
+        key rides one fused dispatch. Sets the ``serve.buckets``
+        gauge as a side effect."""
+        out: Dict[int, List[str]] = {}
+        for uuid, sess in self._resident.items():
+            out.setdefault(int(getattr(sess, "bucket_key", 0)),
+                           []).append(uuid)
+        if obs.enabled():
+            obs.gauge("serve.buckets").set(len(out))
+        return out
+
     # ----------------------------------------------------- transitions
 
     def _gauge(self) -> None:
@@ -82,6 +101,7 @@ class ResidencyManager:
         """Register a (new or restored) session as resident, evicting
         LRU tenants past capacity. The inserted tenant is the MRU."""
         uuid = str(uuid)
+        session.defer_device = self.batched
         self._resident[uuid] = session
         self._resident.move_to_end(uuid)
         self._spilled.pop(uuid, None)
@@ -150,6 +170,28 @@ class ResidencyManager:
                 pass
         self.insert(uuid, sess)
         return sess
+
+    def get_many(self, uuids: List[str]) -> "OrderedDict[str, object]":
+        """Touch a GROUP for one batched tick: every named tenant
+        resident and MRU-bumped before any of them updates, so the
+        restores' evictions can only hit tenants OUTSIDE the group
+        (wave-current between ticks — evictable). The group must fit
+        device memory: more than ``capacity`` uuids cannot be
+        co-resident, and silently splitting here would hide the
+        working-set overflow the caller has to chunk around. Unknown
+        uuids are simply absent from the result (the caller's
+        unknown-tenant path stays loud)."""
+        uuids = [str(u) for u in uuids]
+        if len(uuids) > self.capacity:
+            raise ValueError(
+                f"get_many: group of {len(uuids)} exceeds residency "
+                f"capacity {self.capacity} — chunk the group")
+        out: "OrderedDict[str, object]" = OrderedDict()
+        for uuid in uuids:
+            sess = self.get(uuid)
+            if sess is not None:
+                out[uuid] = sess
+        return out
 
     def sweep_spill(self) -> int:
         """Retention for the spill directory (PR 15: spill packs join

@@ -51,6 +51,7 @@ from .. import obs
 from .. import serde
 from .. import sync
 from ..collections import shared as s
+from .batch import BatchScheduler
 from .controller import BatchController
 from .ingest import IngestQueue
 from .residency import ResidencyManager
@@ -78,7 +79,8 @@ class SyncService:
                  controller: Optional[BatchController] = None,
                  residency: Optional[ResidencyManager] = None,
                  checkpoint_dir: Optional[str] = None,
-                 d_max: int = 64, watchdog_s: Optional[float] = None):
+                 d_max: int = 64, watchdog_s: Optional[float] = None,
+                 batched: bool = True):
         self.queue = queue
         if queue.tenant_known is None:
             # close the front door to uuids nobody serves — such an op
@@ -86,6 +88,15 @@ class SyncService:
             queue.tenant_known = self._knows_tenant
         self.controller = controller or BatchController()
         self.residency = residency or ResidencyManager(capacity=64)
+        # cross-tenant batched ticks (PR 18): touched tenants' delta
+        # windows ride ONE fused dispatch per pow2 bucket instead of
+        # one wave per tenant. ``batched=False`` is the escape hatch —
+        # the per-tenant path, kept for the bit-identity pin and for
+        # bisection; digests, journal contents and lag resolution are
+        # identical either way.
+        self.batched = bool(batched)
+        self.residency.batched = self.batched
+        self._scheduler = BatchScheduler(site="serve")
         self.checkpoint_dir = checkpoint_dir
         self.d_max = int(d_max)
         self.watchdog_s = watchdog_s
@@ -107,11 +118,16 @@ class SyncService:
     def _knows_tenant(self, uuid: str) -> bool:
         return uuid in self.tenants
 
-    def add_tenant(self, left, right) -> str:
+    def add_tenant(self, left, right,
+                   d_max: Optional[int] = None) -> str:
         """Register one tenant document as the replica pair (left,
         right) — distinct sites of one uuid. Uploads the session and
         runs the first (full) wave so the tenant is immediately
-        checkpointable/evictable."""
+        checkpointable/evictable. ``d_max`` overrides the service's
+        delta budget for THIS tenant (a hot tenant earns a wider
+        window); tenants with different budgets land in different
+        pow2 batch buckets — heterogeneity costs extra dispatches per
+        tick, never correctness."""
         from ..parallel.session import FleetSession
 
         uuid = str(left.ct.uuid)
@@ -131,7 +147,9 @@ class SyncService:
                  "why": "evolve() keeps the uuid — a second tenant "
                         "must start from a fresh clist, not an "
                         "evolve() of an already-registered one"})
-        sess = FleetSession([(left, right)], d_max=self.d_max)
+        sess = FleetSession([(left, right)],
+                            d_max=self.d_max if d_max is None
+                            else int(d_max))
         sess.wave()
         self.residency.insert(uuid, sess)
         self.tenants[uuid] = {"applied_seq": 0}
@@ -153,7 +171,8 @@ class SyncService:
             return 1
         return zlib.crc32(site.encode()) & 1
 
-    def _apply_batches(self, uuid: str, entries: List) -> None:
+    def _apply_batches(self, uuid: str, entries: List,
+                       sess=None, wave: bool = True):
         """COALESCE one tenant's drained batches into one wave batch
         per side, apply, and wave once — the admission queue's whole
         point: a deep backlog costs two merges of the unioned delta
@@ -165,8 +184,14 @@ class SyncService:
         not yet visible (cross-site ordering inside one tick) retry
         after the other side; a union that still fails is retried on
         the other replica before being declared poison — admitted ops
-        are never silently dropped."""
-        sess = self.residency.get(uuid)
+        are never silently dropped.
+
+        ``wave=False`` stops before the wave (the batched tick waves
+        all touched tenants at once via the scheduler); ``sess`` skips
+        the residency touch when the caller already holds the session
+        (``get_many``). Returns the session."""
+        if sess is None:
+            sess = self.residency.get(uuid)
         if sess is None:
             if obs.enabled():
                 obs.counter("serve.refusals").inc()
@@ -204,16 +229,20 @@ class SyncService:
             if not pending:
                 break
         sess.update([(sides[0], sides[1])])
-        sess.wave()
+        if wave:
+            sess.wave()
         self.tenants[uuid]["applied_seq"] = max(
             self.tenants[uuid]["applied_seq"],
             max(e.seq for e in entries))
+        return sess
 
     def tick(self, max_ops: Optional[int] = None) -> dict:
-        """One service tick: drain → apply/update/wave per touched
-        tenant → poll the live feed → move T_batch. Returns a small
-        summary dict (ops drained, tenants touched, current
-        t_batch_ms, queue depth after).
+        """One service tick: drain → apply/update per touched tenant →
+        wave (batched: one fused dispatch per pow2 bucket over ALL
+        touched tenants; unbatched: one wave per tenant) → poll the
+        live feed → move T_batch. Returns a small summary dict (ops
+        drained, tenants touched, current t_batch_ms, queue depth
+        after, and the tick's bucket/dispatch accounting).
 
         The default drain bound is ``d_max`` — the session's delta
         window budget. Coalescing more ops than the window holds
@@ -233,6 +262,7 @@ class SyncService:
         by_tenant: Dict[str, List] = {}
         for e in entries:
             by_tenant.setdefault(e.uuid, []).append(e)
+        known: List = []
         for uuid, batch in by_tenant.items():
             if uuid not in self.tenants:
                 # the door predicate makes this unreachable for new
@@ -244,7 +274,39 @@ class SyncService:
                     obs.event("serve.orphan_batch", uuid=uuid,
                               ops=sum(e.ops for e in batch))
                 continue
-            self._apply_batches(uuid, batch)
+            known.append((uuid, batch))
+        # the tick's device dispatch count, read from the costmodel
+        # counter (not inferred): the batched tick's whole claim is
+        # that this collapses from O(#tenants) to O(#buckets)
+        disp0 = obs.counter("costmodel.dispatches").value \
+            if obs.enabled() else 0
+        buckets = 0
+        batch_rows = 0
+        fallbacks = 0
+        if self.batched:
+            # batched tick: residency-capacity-sized groups — touch
+            # the whole group first (a restore's evictions can only
+            # hit tenants outside the group, which are wave-current
+            # between ticks), coalesce and update every member, then
+            # ONE fused dispatch per pow2 bucket via the scheduler
+            cap = max(1, self.residency.capacity)
+            for i in range(0, len(known), cap):
+                chunk = known[i:i + cap]
+                group = self.residency.get_many(
+                    [u for u, _b in chunk])
+                for uuid, batch in chunk:
+                    self._apply_batches(uuid, batch,
+                                        sess=group.get(uuid),
+                                        wave=False)
+                self._scheduler.wave_fleet(group)
+                buckets += self._scheduler.last_buckets
+                batch_rows += self._scheduler.last_batch_rows
+                fallbacks += self._scheduler.last_fallbacks
+        else:
+            for uuid, batch in known:
+                self._apply_batches(uuid, batch)
+        wave_dispatches = (obs.counter("costmodel.dispatches").value
+                           - disp0) if obs.enabled() else 0
         snap = None
         if self._live is not None and not self._live.closed:
             snap = self._live.poll(emit_snapshot=True)
@@ -257,12 +319,17 @@ class SyncService:
                       tenants=len(by_tenant),
                       depth=self.queue.depth,
                       resident=self.residency.resident_docs,
-                      t_batch_ms=round(self.controller.t_batch_ms, 3))
+                      t_batch_ms=round(self.controller.t_batch_ms, 3),
+                      buckets=buckets, batch_rows=batch_rows,
+                      wave_dispatches=wave_dispatches,
+                      fallbacks=fallbacks)
             obs.event("run.heartbeat", stage="serve.tick",
                       ticks=self.ticks, ops=ops)
         return {"ops": ops, "tenants": len(by_tenant),
                 "t_batch_ms": self.controller.t_batch_ms,
-                "depth": self.queue.depth}
+                "depth": self.queue.depth,
+                "buckets": buckets, "batch_rows": batch_rows,
+                "wave_dispatches": wave_dispatches}
 
     def run(self, seconds: float, max_ops: Optional[int] = None) -> int:
         """The paced loop: tick, then sleep the controller's current
@@ -526,7 +593,8 @@ class SyncService:
                 controller: Optional[BatchController] = None,
                 residency: Optional[ResidencyManager] = None,
                 d_max: int = 64,
-                watchdog_s: Optional[float] = None) -> "SyncService":
+                watchdog_s: Optional[float] = None,
+                batched: bool = True) -> "SyncService":
         """Rebuild a service from :meth:`checkpoint` output: every
         tenant restored through the digest gate, then the ingest
         journal replayed above each tenant's watermark (validated
@@ -567,7 +635,7 @@ class SyncService:
                 capacity=int(manifest["residency_capacity"]))
         svc = cls(queue, controller=controller, residency=residency,
                   checkpoint_dir=checkpoint_dir, d_max=d_max,
-                  watchdog_s=watchdog_s)
+                  watchdog_s=watchdog_s, batched=batched)
         with obs.span("serve.restore",
                       tenants=len(manifest.get("tenants") or {})):
             for uuid, info in (manifest.get("tenants") or {}).items():

@@ -1,0 +1,65 @@
+"""Known-bad SRV001 fixture for the PR-18 batch-scheduler APIs: the
+cross-tenant batch scheduler marshals heterogeneous window packs and
+walks per-tenant frontiers on the host — reaching it from a traced
+path unguarded gates exactly like the rest of the serve layer. Only
+the unguarded calls gate — every guard spelling (nested if, aliased
+import, early return, negated-test else) is sanctioned here too, and
+``wave_fleet`` is distinctive enough to gate as a bare attribute on
+an opaque receiver (the scheduler handed in as a parameter)."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu import serve
+from cause_tpu import serve as _serve
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    serve.BatchScheduler(site="serve")               # SRV001: unguarded
+    if obs.enabled():
+        sched = serve.BatchScheduler(site="serve")   # guarded: fine
+        sched.wave_fleet({})
+    if _obs_enabled():
+        # the aliased module spelling is fine under the aliased guard
+        _serve.BatchScheduler()
+    return x * 2
+
+
+@jax.jit
+def traced_bare_name(x):
+    # distinctive bare names gate without a module qualifier too
+    from cause_tpu.serve import BatchScheduler
+
+    BatchScheduler().wave_fleet({})                  # SRV001: unguarded
+    return x + 1
+
+
+@jax.jit
+def traced_wave_fleet(x, sched):
+    # the fleet-wave verb gates on an opaque receiver too — one fused
+    # dispatch still means host-side marshaling of every tenant's pack
+    sched.wave_fleet({})                             # SRV001: unguarded
+    return x
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    serve.BatchScheduler().wave_fleet({})
+    return x * 2
+
+
+@jax.jit
+def traced_negated(x):
+    # guard polarity: the BODY of a negated test runs obs-off only
+    # (flagged — never-useful scheduler call), its ELSE branch is
+    # obs-on only (guarded: fine)
+    if not obs.enabled():
+        serve.BatchScheduler(site="serve")           # SRV001
+    else:
+        serve.BatchScheduler(site="serve")           # fine
+    return x

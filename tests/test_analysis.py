@@ -209,6 +209,24 @@ def test_serve_unguarded_call_on_traced_path():
     assert rules_of(res) == ["SRV001"]
 
 
+def test_batch_scheduler_unguarded_call_on_traced_path():
+    """SRV001 extended (PR-18): the cross-tenant batch scheduler
+    marshals heterogeneous window packs and walks per-tenant frontiers
+    on the host before its one fused dispatch — same
+    never-on-a-traced-path contract as the rest of the serve layer.
+    Exactly four findings — the plain unguarded constructor, a
+    distinctive bare name, ``wave_fleet`` on an opaque receiver, and
+    the body of a negated test; every guard spelling is sanctioned."""
+    res = run_api(os.path.join(FIX, "batch_caller_bad.py"))
+    srv = [f for f in res.findings if f.rule == "SRV001"]
+    assert len(srv) == 4, [f.message for f in srv]
+    assert "BatchScheduler" in srv[0].message
+    assert "BatchScheduler" in srv[1].message
+    assert "wave_fleet" in srv[2].message
+    assert "BatchScheduler" in srv[3].message
+    assert rules_of(res) == ["SRV001"]
+
+
 def test_net_unguarded_call_on_traced_path():
     """NET001 (PR-13): the network-transport layer blocks on sockets,
     sleeps out reconnect backoff and mutates connection state — host
@@ -478,7 +496,8 @@ def test_cli_exit_codes():
     "obs_caller_bad.py", "devprof_caller_bad.py",
     "semantic_caller_bad.py", "costmodel_caller_bad.py",
     "lag_caller_bad.py", "live_caller_bad.py",
-    "chaos_caller_bad.py", "serve_caller_bad.py", "net_caller_bad.py",
+    "chaos_caller_bad.py", "serve_caller_bad.py",
+    "batch_caller_bad.py", "net_caller_bad.py",
     "wal_caller_bad.py", "lca_bad.py",
     "lck_guard_bad.py", "lck_watermark_bad.py", "lck_order_bad.py",
     "lck_block_bad.py", "lck_reentrant_bad.py", "dur_ack_bad.py",

@@ -1,0 +1,371 @@
+"""PR 18: cross-tenant batched serving.
+
+Pins the batched tick's contracts:
+
+- **bit-identity** — the same admitted-op schedule through a batched
+  and an unbatched service converges to identical per-tenant digests,
+  identical journal contents, and identical lag resolution (batching
+  changes WHEN device programs run, never what they compute);
+- **dispatch collapse** — a steady-state batched tick pays one device
+  dispatch per pow2 BUCKET (costmodel-counted), not three per tenant;
+- **per-tenant fallback** — one tenant degrading (delta overflow,
+  window outgrowing its bucket) runs the full-width rung alone; its
+  bucket-mates still share one fused dispatch;
+- **escape hatch** — ``batched=False`` keeps the per-tenant path, and
+  checkpoints round-trip across the two modes.
+"""
+
+import json
+import os
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import chaos, obs, serde, sync
+from cause_tpu.obs import lag as obs_lag
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id
+from cause_tpu.serve import (IngestJournal, IngestQueue,
+                             ResidencyManager, SyncService)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    for k in ("CAUSE_TPU_CHAOS", "CAUSE_TPU_OBS", "CAUSE_TPU_OBS_OUT"):
+        monkeypatch.delenv(k, raising=False)
+    chaos.reset()
+    obs.reset()
+    obs_lag.reset()  # obs.reset does not reach the lag tracer
+    sync.quarantine_reset()
+    yield
+    chaos.reset()
+    obs.reset()
+    obs_lag.reset()
+    sync.quarantine_reset()
+
+
+def _events(name=None):
+    evs = [e for e in obs.events() if e.get("ev") == "event"]
+    if name is None:
+        return evs
+    return [e for e in evs if e.get("name") == name]
+
+
+def _base(n=12):
+    base = CausalList(c_list.weave(
+        c.clist(weaver="jax").extend(["w"] * n).ct
+    ))
+    base.ct.lanes.segments()
+    return base
+
+
+def _pair(base):
+    a = CausalList(base.ct.evolve(site_id=new_site_id()))
+    b = CausalList(base.ct.evolve(site_id=new_site_id()))
+    return a.conj("A"), b.conj("B")
+
+
+def _delta_items(new, old):
+    return serde.encode_node_items(
+        sync.delta_nodes(new, sync.version_vector(old)))
+
+
+def _service(root, capacity=8, d_max=16, **kw):
+    os.makedirs(str(root), exist_ok=True)
+    jr = IngestJournal(os.path.join(str(root), "wal.jsonl"))
+    q = IngestQueue(max_ops=4096, journal=jr)
+    return SyncService(
+        q, residency=ResidencyManager(capacity=capacity),
+        checkpoint_dir=os.path.join(str(root), "ckpt"),
+        d_max=d_max, **kw)
+
+
+def _mint_schedule(tenants, rounds=4):
+    """One deterministic multi-tenant offer schedule: per round, a
+    rotating subset of tenants each mint a left- and/or right-side op
+    on their external site replicas (mutated IN PLACE), recorded as
+    wire bytes so BOTH arms replay the exact same admitted-op
+    schedule. ``None`` entries mark tick boundaries."""
+    log = []
+    for k in range(rounds):
+        for i, t in enumerate(tenants):
+            if (i + k) % 3 == 0:
+                nl = t["l"].conj(f"L{i}.{k}")
+                log.append((t["uuid"], nl.ct.site_id,
+                            _delta_items(nl, t["l"])))
+                t["l"] = nl
+            if (i + k) % 2 == 0:
+                nr = t["r"].conj(f"R{i}.{k}")
+                log.append((t["uuid"], nr.ct.site_id,
+                            _delta_items(nr, t["r"])))
+                t["r"] = nr
+        log.append(None)  # tick marker
+    return log
+
+
+def _replay(svc, log):
+    for entry in log:
+        if entry is None:
+            svc.tick()
+        else:
+            uuid, site, items = entry
+            assert svc.queue.offer(uuid, site, items).admitted
+
+
+def _lag_counts():
+    return {k: obs.counter(f"lag.ops_{k}").value
+            for k in ("created", "woven", "converged")}
+
+
+def _lag_by_uuid(skip=0):
+    """Per-tenant lag resolution from the captured obs stream: total
+    ops woven/converged per uuid across the lag.window records after
+    the first ``skip`` of them."""
+    out = {}
+    for e in _events("lag.window")[skip:]:
+        f = e["fields"]
+        d = out.setdefault(f["uuid"], [0, 0])
+        d[0] += f["woven"]
+        d[1] += f["converged"]
+    return {k: tuple(v) for k, v in out.items()}
+
+
+def _journal_rows(root):
+    rows = []
+    with open(os.path.join(str(root), "wal.jsonl")) as f:
+        for line in f:
+            e = json.loads(line)
+            rows.append((e.get("seq"), e.get("uuid"), e.get("site"),
+                         json.dumps(e.get("items"), sort_keys=True)))
+    return rows
+
+
+def test_batched_vs_unbatched_bit_identity(tmp_path):
+    """THE pin: same admitted-op schedule, batching on vs off —
+    identical converged digests, journal contents and lag resolution
+    per tenant. Capacity below the tenant count on BOTH arms, so the
+    schedule also crosses evict/restore and the batched arm's
+    capacity-sized chunking."""
+    obs.configure(enabled=True)
+    svc_b = _service(tmp_path / "b", capacity=3, batched=True)
+    assert svc_b.batched
+    tenants = []
+    for i in range(6):
+        a, b = _pair(_base(10 + i))
+        d_max = 16 if i % 2 == 0 else 48  # two pow2 buckets
+        svc_b.add_tenant(a, b, d_max=d_max)
+        tenants.append({"uuid": str(a.ct.uuid), "l": a, "r": b,
+                        "a": a, "b": b, "d_max": d_max})
+    log = _mint_schedule(tenants)
+    # the mints above stamped their ops at the mutation funnel; a
+    # replaying arm stamps the same ops at INGEST instead. Reset the
+    # tracer (fresh per-doc lamport watermarks) and measure each arm
+    # as counter deltas from here, so both arms resolve
+    # identically-stamped (ingest-stamped) ops
+    obs_lag.reset()
+    lag_b0 = _lag_counts()
+    win_b0 = len(_events("lag.window"))
+    _replay(svc_b, log)
+    dig_b = {t["uuid"]: svc_b.converged_digest(t["uuid"])
+             for t in tenants}
+    edn_b = {t["uuid"]: c.causal_to_edn(svc_b.materialize(t["uuid"]))
+             for t in tenants}
+    lag_b = {k: v - lag_b0[k] for k, v in _lag_counts().items()}
+    per_uuid_b = _lag_by_uuid(skip=win_b0)
+    agreed_b = {}
+    for e in _events("wave.digest"):
+        f = e["fields"]
+        if f.get("agreed"):
+            agreed_b[f["uuid"]] = agreed_b.get(f["uuid"], 0) + 1
+
+    obs.reset()
+    obs_lag.reset()  # arm isolation: fresh lamport watermarks too
+    obs.configure(enabled=True)
+    svc_u = _service(tmp_path / "u", capacity=3, batched=False)
+    assert not svc_u.batched  # the escape hatch
+    for t in tenants:
+        svc_u.add_tenant(t["a"], t["b"], d_max=t["d_max"])
+    obs_lag.reset()
+    lag_u0 = _lag_counts()
+    win_u0 = len(_events("lag.window"))
+    _replay(svc_u, log)
+    for t in tenants:
+        uuid = t["uuid"]
+        assert svc_u.converged_digest(uuid) == dig_b[uuid]
+        assert c.causal_to_edn(svc_u.materialize(uuid)) == edn_b[uuid]
+    # identical journal contents: same admissions, same order, same
+    # wire bytes (timestamps excluded — they are wall-clock)
+    assert _journal_rows(tmp_path / "b") == _journal_rows(tmp_path / "u")
+    # identical lag resolution: every op created/woven/converged the
+    # same number of times, and every tenant agreed in at least one
+    # wave on both arms
+    lag_u = {k: v - lag_u0[k] for k, v in _lag_counts().items()}
+    assert lag_u == lag_b
+    assert lag_u["created"] > 0  # the comparison is not vacuous
+    assert _lag_by_uuid(skip=win_u0) == per_uuid_b
+    agreed_u = {}
+    for e in _events("wave.digest"):
+        f = e["fields"]
+        if f.get("agreed"):
+            agreed_u[f["uuid"]] = agreed_u.get(f["uuid"], 0) + 1
+    assert set(agreed_b) == set(agreed_u) == {t["uuid"]
+                                             for t in tenants}
+
+
+def test_batched_tick_one_dispatch_per_bucket(tmp_path):
+    """Steady state, 6 tenants in 2 pow2 buckets, capacity ample:
+    the tick's device dispatch count (costmodel-counted) equals the
+    bucket count — not 3 per tenant — and the serve.tick/wave.cost
+    events carry the bucket/batch_rows attribution."""
+    obs.configure(enabled=True)
+    svc = _service(tmp_path, capacity=8, batched=True)
+    tenants = []
+    for i in range(6):
+        # n=8 keeps every side well under the session's pow2 lane
+        # capacity: one more op must ride the delta path, not a
+        # capacity-growth full re-upload
+        a, b = _pair(_base(8))
+        svc.add_tenant(a, b, d_max=16 if i % 2 == 0 else 48)
+        tenants.append({"uuid": str(a.ct.uuid), "l": a, "r": b})
+    for t in tenants:
+        nl = t["l"].conj("x")
+        assert svc.queue.offer(t["uuid"], nl.ct.site_id,
+                               _delta_items(nl, t["l"])).admitted
+        t["l"] = nl
+    out = svc.tick()
+    assert out["tenants"] == 6
+    assert out["buckets"] == 2
+    assert out["wave_dispatches"] == 2  # ONE fused dispatch per bucket
+    ticks = _events("serve.tick")
+    f = ticks[-1]["fields"]
+    assert f["buckets"] == 2 and f["wave_dispatches"] == 2
+    assert f["batch_rows"] >= 6 and f["fallbacks"] == 0
+    costs = [e["fields"] for e in _events("wave.cost")
+             if e["fields"].get("path") == "batched"]
+    assert len(costs) == 2
+    assert {cf["bucket"] for cf in costs} == {32, 64}
+    assert all(cf["dispatches"] == 1 for cf in costs)
+    assert sum(cf["tenants"] for cf in costs) == 6
+    # every tenant still observed its own agreeing wave.digest
+    agreed = {e["fields"]["uuid"] for e in _events("wave.digest")
+              if e["fields"].get("agreed")}
+    assert {t["uuid"] for t in tenants} <= agreed
+
+
+def test_unbatched_tick_pays_per_tenant_dispatches(tmp_path):
+    """The baseline the collapse is measured against: the per-tenant
+    path pays splice + window weave + rank splice = 3 dispatches per
+    touched tenant per steady-state tick."""
+    obs.configure(enabled=True)
+    svc = _service(tmp_path, capacity=8, batched=False)
+    tenants = []
+    for i in range(4):
+        a, b = _pair(_base(8))
+        svc.add_tenant(a, b)
+        tenants.append({"uuid": str(a.ct.uuid), "l": a})
+    for t in tenants:
+        nl = t["l"].conj("x")
+        assert svc.queue.offer(t["uuid"], nl.ct.site_id,
+                               _delta_items(nl, t["l"])).admitted
+        t["l"] = nl
+    out = svc.tick()
+    assert out["tenants"] == 4
+    assert out["buckets"] == 0  # no scheduler on the escape hatch
+    assert out["wave_dispatches"] == 3 * 4
+
+
+def test_overflowing_tenant_falls_back_alone(tmp_path):
+    """One tenant's single batch exceeds its delta budget — it takes
+    the declared full-width rung (recovery evidence and all) while
+    its bucket-mates still share ONE fused dispatch."""
+    obs.configure(enabled=True)
+    svc = _service(tmp_path, capacity=8, d_max=16, batched=True)
+    tenants = []
+    for i in range(3):
+        a, b = _pair(_base(10 + i))
+        svc.add_tenant(a, b)
+        tenants.append({"uuid": str(a.ct.uuid), "l": a, "r": b})
+    # tenant 0: one 20-op batch > d_max=16 — update degrades to a
+    # full upload, dropping the frontier
+    big = tenants[0]["l"]
+    for j in range(20):
+        big = big.conj(f"big{j}")
+    assert svc.queue.offer(tenants[0]["uuid"], big.ct.site_id,
+                           _delta_items(big, tenants[0]["l"])).admitted
+    for t in tenants[1:]:
+        nl = t["l"].conj("x")
+        assert svc.queue.offer(t["uuid"], nl.ct.site_id,
+                               _delta_items(nl, t["l"])).admitted
+        t["l"] = nl
+    # default drain bound is d_max — raise it so all three tenants
+    # land in ONE tick (the point is same-tick fallback + batching)
+    out = svc.tick(max_ops=32)
+    assert out["tenants"] == 3
+    f = _events("serve.tick")[-1]["fields"]
+    assert f["buckets"] == 1 and f["fallbacks"] == 1
+    # 1 bucket dispatch + the fallback's full wave (v5 + digest)
+    assert f["wave_dispatches"] == 3
+    steps = [e["fields"] for e in _events("recovery.step")]
+    assert any(s.get("reason") == "delta-overflow" for s in steps)
+    # the overflowing tenant still converged, bit-identical to the
+    # pure oracle
+    oracle = CausalList(
+        big.ct.evolve(weaver="pure", lanes=None)).merge(
+        CausalList(tenants[0]["r"].ct.evolve(weaver="pure",
+                                             lanes=None)))
+    assert c.causal_to_edn(svc.materialize(tenants[0]["uuid"])) \
+        == c.causal_to_edn(oracle)
+
+
+def test_checkpoint_round_trips_across_modes(tmp_path):
+    """A batched service's drain restores as an unbatched service
+    (and back) with bit-identical digests: the checkpoint format is
+    mode-blind, so ``batched=False`` works for bisection on any
+    existing checkpoint."""
+    svc = _service(tmp_path / "one", capacity=4, batched=True)
+    a, b = _pair(_base())
+    uuid = svc.add_tenant(a, b)
+    nl = a.conj("x1").conj("x2")
+    assert svc.queue.offer(uuid, nl.ct.site_id,
+                           _delta_items(nl, a)).admitted
+    svc.tick()
+    manifest = svc.drain()
+    d0 = svc.converged_digest(uuid)
+    svc2 = SyncService.restore(os.path.dirname(manifest),
+                               batched=False)
+    assert not svc2.batched
+    assert svc2.converged_digest(uuid) == d0
+    # restored-unbatched keeps ticking; a re-drain restores batched
+    l2, _r2 = svc2.residency.get(uuid).pairs[0]
+    l3 = l2.conj("x3")
+    assert svc2.queue.offer(uuid, l3.ct.site_id,
+                            _delta_items(l3, l2)).admitted
+    svc2.tick()
+    manifest2 = svc2.drain(os.path.join(str(tmp_path), "two"))
+    d1 = svc2.converged_digest(uuid)
+    svc3 = SyncService.restore(os.path.dirname(manifest2))
+    assert svc3.batched
+    assert svc3.converged_digest(uuid) == d1
+
+
+def test_residency_buckets_and_get_many(tmp_path):
+    """Bucket-aware residency: resident tenants group by their pow2
+    bucket key, and get_many refuses groups larger than capacity
+    (co-residency is the batched tick's prerequisite, so splitting
+    silently would hide a working-set overflow)."""
+    svc = _service(tmp_path, capacity=4, batched=True)
+    uuids = []
+    for i in range(4):
+        a, b = _pair(_base(10 + i))
+        uuids.append(svc.add_tenant(a, b, d_max=16 if i < 2 else 48))
+    bk = svc.residency.buckets()
+    assert sorted(bk) == [32, 64]
+    assert sorted(bk[32]) == sorted(uuids[:2])
+    assert sorted(bk[64]) == sorted(uuids[2:])
+    got = svc.residency.get_many(uuids)
+    assert list(got) == uuids
+    with pytest.raises(ValueError):
+        svc.residency.get_many(uuids + ["one-too-many"])
+    # sessions carry the deferred-splice mark in batched mode
+    assert all(s.defer_device for s in got.values())
