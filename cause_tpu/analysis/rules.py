@@ -77,7 +77,7 @@ REGISTRY: dict = {}
 # Bumped whenever rule logic or the rule set changes; the incremental
 # cache (core.cached_run) keys on it so a rule-set change invalidates
 # every cached verdict even when no analyzed file changed.
-RULESET_VERSION = 3  # PR 18: SRV001 covers the batch-scheduler APIs
+RULESET_VERSION = 4  # PR 19: XTR001 gates the cross-process tracer
 
 
 def rule(rule_id: str, help_text: str):
@@ -710,6 +710,25 @@ _GUARD_RULES = (
         "drains subscriber queues, folds records and evaluates alert "
         "rules when obs is on",
         prefix="live"),
+    # distinctive bare names only: ``hop``/``bind_ops``/``trace_of``
+    # are unambiguous; a generic spelling like ``reset`` matches
+    # through the ``xtrace`` module qualifier instead
+    _GuardSpec(
+        "XTR001",
+        "cross-process tracing API reached from jit-reachable code "
+        "without an obs.enabled() guard (the xtrace layer takes the "
+        "span-registry lock, mints span ids and assembles hop/clock "
+        "event payloads the moment obs is on)",
+        frozenset({"hop", "new_trace", "bind_ops", "trace_of",
+                   "traces_of", "wire_context", "continue_from",
+                   "clock_sample", "reply_stamp", "last_span"}),
+        frozenset({"xtrace", "_xtrace"}),
+        lambda module: False,
+        "an obs.enabled()",
+        "unlike the no-op span/counter factories, the tracer takes "
+        "the registry lock, mints span ids and builds hop records "
+        "when obs is on",
+        prefix="xtrace"),
     # ``run_dispatch``/``is_transient`` are SANCTIONED unguarded —
     # run_dispatch IS the dispatch path (its idle cost is one
     # chaos.enabled() read and a try frame)

@@ -224,7 +224,15 @@ def insert(weave_fn: WeaveFn, ct: CausalTree, node, more_nodes_in_tx=None) -> Ca
         # validated path), so this is the one host-side stamp point —
         # site and lamport ride in the node id, the monotonic clock is
         # captured inside op_created, all outside any trace
-        obs.lag.op_created(ct.uuid, [nd[0] for nd in nodes])
+        op_ids = [nd[0] for nd in nodes]
+        obs.lag.op_created(ct.uuid, op_ids)
+        # distributed-trace mint (PR 19): the same funnel is where a
+        # locally-created batch gets its causal identity; ops already
+        # bound (a replayed run) keep their original trace
+        tr = obs.xtrace.new_trace()
+        obs.xtrace.hop("mint", tr, parent="", source="funnel",
+                       uuid=str(ct.uuid), ops=len(nodes))
+        obs.xtrace.bind_ops(tr, op_ids)
     # a non-chaining same-tx run is the one input whose INCREMENTAL
     # weave (contiguous splice at the run head's cause — the
     # runs-stick-together rule) differs from a from-scratch rebuild

@@ -55,6 +55,7 @@ from typing import Dict, List, Optional
 from .. import obs
 from .. import sync
 from ..collections import shared as s
+from ..obs import xtrace
 from . import transport
 from .transport import FrameStream
 
@@ -319,7 +320,13 @@ class ReplicationServer:
             obs.event("net.hello", peer=conn.peer,
                       client=str(frame.get("client") or ""),
                       tenants=len(wm), unknown=len(unknown))
-        return {"op": "welcome", "wm": wm, "unknown": unknown}
+        reply = {"op": "welcome", "wm": wm, "unknown": unknown}
+        if obs.enabled():
+            # wall-clock stamp for the client's NTP-style offset
+            # estimate (xtrace.clock_sample); obs-off replies stay
+            # byte-identical (scripts/obs_off_pin.py)
+            reply.update(xtrace.reply_stamp())
+        return reply
 
     def _seq_guard(self, conn: _Conn, seq: int) -> Optional[dict]:
         """The per-connection at-least-once guard, shared by pings
@@ -352,6 +359,9 @@ class ReplicationServer:
             obs.counter("net.heartbeats").inc()
             obs.event("net.heartbeat", peer=conn.peer, side="server")
         reply = {"op": "pong", "seq": seq}
+        if obs.enabled():
+            # heartbeat = recurring clock-offset sample (see _welcome)
+            reply.update(xtrace.reply_stamp())
         conn.last_seq = seq
         conn.last_reply = dict(reply)
         return reply
@@ -409,6 +419,34 @@ class ReplicationServer:
             self._bump("poison_nacks")
             sync.note_reject(site, uuid=uuid, why=why)
             return finish(self._nack(seq, why, uuid=uuid, site=site))
+        # --- trace continuation (PR 19): an obs-on client attached
+        # wire contexts; continue each chain with a "recv" hop and
+        # hand the trace ids to admission so journal/tick/wave hops
+        # stay linked. Garbage ctx degrades to an untraced frame —
+        # never an exception on the admission path. Runs AFTER the
+        # validate boundary: a poison frame earns no hops.
+        traces: List[str] = []
+        if obs.enabled():
+            raw_ctx = frame.get("ctx")
+            if isinstance(raw_ctx, list):
+                for c in raw_ctx[:16]:
+                    tr, parent = xtrace.continue_from(c)
+                    if not tr:
+                        continue
+                    xtrace.hop("recv", tr, parent=parent,
+                               peer=conn.peer, seq=seq, uuid=uuid,
+                               site=site)
+                    ids = c.get("ids")
+                    if isinstance(ids, list):
+                        # bind this batch's op ids server-side so the
+                        # lag tracer's converged/apply hops and the
+                        # op.lag trace field can join (suppressed ids
+                        # bind too — harmless, they never re-apply)
+                        xtrace.bind_ops(
+                            tr, [tuple(i) for i in ids[:64]
+                                 if isinstance(i, list)
+                                 and len(i) == 3])
+                    traces.append(tr)
         # --- idempotent re-delivery: the lamport watermark filter.
         # Filter -> offer -> advance runs ATOMICALLY under the
         # watermark lock: a client that reconnects while an old
@@ -439,7 +477,8 @@ class ReplicationServer:
                 self._bump("acks")
                 return finish({"op": "ack", "seq": seq, "admitted": 0,
                                "dup": suppressed})
-            adm = self.queue.offer(uuid, site, kept)
+            adm = self.queue.offer(uuid, site, kept,
+                                   traces=traces or None)
             if adm.admitted:
                 last = kept[-1][0]
                 wm[site] = [int(last[0]), int(last[2])]

@@ -51,6 +51,7 @@ from .. import obs
 from .. import serde
 from .. import sync
 from ..collections import shared as s
+from ..obs import xtrace
 from . import transport
 from .transport import Backoff, FrameStream
 
@@ -87,6 +88,10 @@ class NetClient:
             seed=zlib.crc32(self.client_id.encode()))
         # (uuid, site) -> ordered op triples [(id, cause, value)]
         self._pending: Dict[Tuple[str, str], List[tuple]] = {}
+        # (uuid, site) -> [(trace_id, [op ids])] for still-pending
+        # batches (PR 19; populated only while obs is on — obs-off
+        # ships byte-identical frames, see scripts/obs_off_pin.py)
+        self._pending_traces: Dict[Tuple[str, str], List[tuple]] = {}
         self._pending_ops = 0
         self._server_wm: Dict[str, Dict[str, list]] = {}
         self._fs: Optional[FrameStream] = None
@@ -139,6 +144,17 @@ class NetClient:
         if obs.enabled():
             obs.gauge(f"net.outbound_depth.{self.client_id}").set(
                 self._pending_ops)
+            # mint the batch's causal identity at the producer: one
+            # trace per queued batch, root "mint" hop, op ids bound
+            # for the lag→journey drill-down
+            trace = xtrace.new_trace()
+            xtrace.hop("mint", trace, parent="",
+                       client=self.client_id, uuid=str(uuid),
+                       site=str(site), ops=len(triples))
+            op_ids = [t[0] for t in triples]
+            xtrace.bind_ops(trace, op_ids)
+            self._pending_traces.setdefault(key, []).append(
+                (trace, op_ids))
         return True
 
     # ------------------------------------------------------ plumbing
@@ -176,11 +192,20 @@ class NetClient:
         fs = transport.dial(self.host, self.port, site=self.site,
                             connect_timeout_s=self.connect_timeout_s,
                             read_timeout_s=self.read_timeout_s)
+        t0_us = time.time_ns() // 1000
         transport.send_msg(fs, {"op": "hello",
                                 "client": self.client_id,
                                 "uuids": self.uuids})
         welcome = transport.recv_msg(fs,
                                      timeout_s=self.read_timeout_s)
+        t1_us = time.time_ns() // 1000
+        if obs.enabled():
+            # the welcome is a request/response pair with a server
+            # wall-clock stamp (obs-on servers only): one NTP-style
+            # clock-offset sample per (re)connect for journey's
+            # cross-host ordering
+            xtrace.clock_sample(welcome if isinstance(welcome, dict)
+                                else {}, t0_us, t1_us, via="hello")
         if not (isinstance(welcome, dict)
                 and welcome.get("op") == "welcome"
                 and isinstance(welcome.get("wm"), dict)):
@@ -238,6 +263,10 @@ class NetClient:
                     self._pending[(uuid, site_id)] = fresh
                 else:
                     del self._pending[(uuid, site_id)]
+                    # batch fully resumed away: the server admitted
+                    # it before the link died — its journey continues
+                    # from the server-side hops, nothing left to ship
+                    self._pending_traces.pop((uuid, site_id), None)
         if skipped:
             self.stats["resumed_skipped_ops"] += skipped
             if obs.enabled():
@@ -333,6 +362,28 @@ class NetClient:
         seq = self._seq
         frame = {"op": "delta", "seq": seq, "uuid": uuid,
                  "site": site_id, "nodes": enc, "crc": crc}
+        if obs.enabled():
+            # one "send" hop per coalesced batch in this frame; the
+            # frame carries their contexts so the server continues
+            # the chain with "recv". A retransmit (blackhole, ack
+            # lost) emits fresh send hops on the SAME traces — the
+            # retry is journey-visible. Obs-off: no ctx key, frame
+            # bytes pinned (scripts/obs_off_pin.py).
+            ctxs = []
+            for tr, op_ids in self._pending_traces.get(
+                    (uuid, site_id), ()):
+                span = xtrace.hop("send", tr, client=self.client_id,
+                                  seq=seq, uuid=uuid, site=site_id,
+                                  ops=len(ops))
+                ctx = xtrace.wire_context(tr, span)
+                if ctx:
+                    # the batch's op ids ride along so the SERVER can
+                    # bind ops→trace in its own registry (the lag→
+                    # journey drill-down is server-side)
+                    ctx["ids"] = [list(i) for i in op_ids[:64]]
+                    ctxs.append(ctx)
+            if ctxs:
+                frame["ctx"] = ctxs
         self.stats["sent_frames"] += 1
         if not transport.send_msg(self._fs, frame):
             # blackhole: the frame "went out" but never arrives; the
@@ -345,6 +396,7 @@ class NetClient:
         if op == "ack":
             self._pending_ops -= len(ops)
             self._pending.pop((uuid, site_id), None)
+            self._pending_traces.pop((uuid, site_id), None)
             self.stats["acked_ops"] += int(reply.get("admitted") or 0)
             # ops the server suppressed as re-delivery (a lost ack's
             # resend): cleared from pending too, accounted separately
@@ -375,8 +427,14 @@ class NetClient:
 
     def _heartbeat(self) -> None:
         self._seq += 1
+        t0_us = time.time_ns() // 1000
         transport.send_msg(self._fs, {"op": "ping", "seq": self._seq})
         reply = self._recv_matching(self._seq)
+        if obs.enabled():
+            # every heartbeat refreshes the clock-offset estimate
+            # (pong carries ts_us/pid from obs-on servers)
+            xtrace.clock_sample(reply, t0_us,
+                                time.time_ns() // 1000, via="ping")
         if reply.get("op") != "pong":
             raise s.CausalError(
                 "net: unexpected heartbeat reply",

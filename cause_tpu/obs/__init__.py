@@ -25,9 +25,11 @@ from .core import (
 )
 from .perfetto import export_perfetto, load_jsonl, to_chrome_trace
 from . import costmodel
+from . import journey
 from . import lag
 from . import live
 from . import semantic
+from . import xtrace
 
 __all__ = [
     "configure",
@@ -41,6 +43,7 @@ __all__ = [
     "export_perfetto",
     "flush",
     "gauge",
+    "journey",
     "lag",
     "live",
     "load_jsonl",
@@ -51,4 +54,5 @@ __all__ = [
     "subscribe",
     "to_chrome_trace",
     "unsubscribe",
+    "xtrace",
 ]

@@ -6,6 +6,7 @@
     python -m cause_tpu.obs fleet events.jsonl           # fleet health
     python -m cause_tpu.obs gap [--obs events.jsonl]     # gap report
     python -m cause_tpu.obs lag events.jsonl             # lag tracer
+    python -m cause_tpu.obs journey <trace|--worst N> .. # journeys
     python -m cause_tpu.obs watch events.jsonl [--once]  # live watch
 
 The default (first) form converts an obs JSONL event stream to a
@@ -48,6 +49,10 @@ def main(argv=None) -> int:
         from .lag import main as lag_main
 
         return lag_main(argv[1:])
+    if argv and argv[0] == "journey":
+        from .journey import main as journey_main
+
+        return journey_main(argv[1:])
     if argv and argv[0] == "watch":
         from .watch import main as watch_main
 

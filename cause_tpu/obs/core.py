@@ -271,6 +271,18 @@ def configure(enabled: Optional[bool] = None,
                         s.closed = True
                     cur.subscribers = ()
             _STATE = None
+    if reset:
+        # one reset reaches every tracer layered on this core: the
+        # lag registries and the xtrace span/op registries would
+        # otherwise leak state (and trace bindings) across test cases
+        # and bench fleets. Late imports — both modules import core
+        # at module level, so the top of this file cannot import them
+        from . import lag as _lag
+        from . import xtrace as _xtrace
+
+        _lag.reset()
+        _xtrace.reset()
+    with _STATE_LOCK:
         if reset and enabled is None and out is None \
                 and ring_size is None:
             return

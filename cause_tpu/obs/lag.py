@@ -74,6 +74,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from . import core
+from . import xtrace
 
 __all__ = [
     "SLO_DEFAULT_MS",
@@ -110,6 +111,8 @@ _REPLICA_MAX = 256
 # per-op op.lag events emitted per resolution batch; histograms and
 # counters always account every op — the sample only bounds stream size
 _OP_EVENT_SAMPLE = 64
+# distinct traces earning a "converged" journey hop per wave (PR 19)
+_TRACE_HOP_MAX = 256
 # sliding window of recent converged lags behind the p50/p95/p99 gauges
 _WINDOW_MAX = 256
 
@@ -241,7 +244,8 @@ _DOCS: Dict[str, dict] = {}
 # generation — the generation rides in every ``lag.replica`` record,
 # and the read side merges across generations instead of letting the
 # restarted cumulative record clobber the richer pre-eviction one
-_REPLICAS: Dict[str, Tuple[int, LagHistogram]] = {}
+# replica -> [generation, histogram, worst (lag_us, trace_id) | None]
+_REPLICAS: Dict[str, list] = {}
 _REPLICA_GEN = 0
 _HIST_WOVEN = LagHistogram()
 _HIST_CONVERGED = LagHistogram()
@@ -391,8 +395,8 @@ def ops_applied(uuid: str, op_ids: Iterable, replica: str = "") -> None:
         entry = _REPLICAS.pop(rep, None)
         if entry is None:
             _REPLICA_GEN += 1
-            entry = (_REPLICA_GEN, LagHistogram())
-        gen, hist = entry
+            entry = [_REPLICA_GEN, LagHistogram(), None]
+        gen, hist = entry[0], entry[1]
         _REPLICAS[rep] = entry
         while len(_REPLICAS) > _REPLICA_MAX:
             _REPLICAS.pop(next(iter(_REPLICAS)))
@@ -401,8 +405,14 @@ def ops_applied(uuid: str, op_ids: Iterable, replica: str = "") -> None:
             if stamp is None:
                 stamp = woven.get(op)
             if stamp is not None:
-                hist.record_us((now - stamp) * 1e6)
+                lag_us = (now - stamp) * 1e6
+                hist.record_us(lag_us)
                 applied += 1
+                # worst-offender exemplar: the replica's slowest apply
+                # keeps its trace id, so `obs lag` can print the exact
+                # id `obs journey` drills into (PR 19)
+                if entry[2] is None or lag_us > entry[2][0]:
+                    entry[2] = (lag_us, xtrace.trace_of(op))
             else:
                 lam = _lamport_of(op)
                 if lam is not None and lam <= d["hwm"]:
@@ -420,13 +430,19 @@ def ops_applied(uuid: str, op_ids: Iterable, replica: str = "") -> None:
                 stamped += 1
         _bound_ops(new)
         hist_fields = hist.to_fields()
+        worst = entry[2]
     if stamped:
         core.counter("lag.ops_created").inc(stamped)
     if applied:
         core.counter("lag.ops_applied").inc(applied)
+        extra = {}
+        if worst is not None:
+            extra["worst_lag_ms"] = round(worst[0] / 1000.0, 3)
+            if worst[1]:
+                extra["worst_trace"] = worst[1]
         core.event("lag.replica", replica=rep, uuid=u,
                    applied=applied, epoch=_EPOCH, gen=gen,
-                   hist=hist_fields)
+                   hist=hist_fields, **extra)
 
 
 # -------------------------------------------------------- resolution
@@ -529,10 +545,34 @@ def wave_observed(uuid: str, agreed: bool, source: str = "wave",
     for phase, batch in (("woven", woven_out), ("converged", conv_out)):
         core.counter(f"lag.ops_{phase}").inc(len(batch))
         for op, stamp in batch[:_OP_EVENT_SAMPLE]:
+            extra = {}
+            tr = xtrace.trace_of(op)
+            if tr:
+                # the lag→journey drill-down (PR 19): this id is
+                # exactly what `obs journey <trace>` accepts
+                extra["trace"] = tr
             core.event("op.lag", uuid=u, phase=phase,
                        site=_site_of(op), lamport=_lamport_of(op),
                        lag_ms=round((now - stamp) * 1000.0, 3),
-                       source=str(source))
+                       source=str(source), **extra)
+    # terminal journey hop (PR 19): one "converged" hop per distinct
+    # trace whose ops just fleet-converged, carrying that trace's
+    # WORST create→converged lag (the per-hop SLO decomposition's
+    # final edge). Bounded per wave like every other emission here.
+    if conv_out:
+        worst_by_trace: Dict[str, float] = {}
+        for op, stamp in conv_out:
+            tr = xtrace.trace_of(op)
+            if tr is None:
+                continue
+            lag_ms = (now - stamp) * 1000.0
+            if lag_ms > worst_by_trace.get(tr, -1.0):
+                worst_by_trace[tr] = lag_ms
+            if len(worst_by_trace) >= _TRACE_HOP_MAX:
+                break
+        for tr, lag_ms in worst_by_trace.items():
+            xtrace.hop("converged", tr, uuid=u,
+                       lag_ms=round(lag_ms, 3), source=str(source))
     if breaches:
         core.counter("lag.slo_breach").inc(breaches)
     win = _window_stats(window, slo)
@@ -672,22 +712,32 @@ class LagReducer:
 
         replicas = []
         rep_hists: Dict[str, LagHistogram] = {}
+        rep_worst: Dict[str, tuple] = {}  # (worst_lag_ms, trace)
         for f in self._replicas.values():
             if epoch is not None and f.get("epoch") != epoch:
                 continue
             h = LagHistogram.from_fields(f.get("hist"))
             if not h.count:
                 continue
-            rep_hists.setdefault(str(f.get("replica")),
-                                 LagHistogram()).merge(h)
+            rep = str(f.get("replica"))
+            rep_hists.setdefault(rep, LagHistogram()).merge(h)
+            w = f.get("worst_lag_ms")
+            if isinstance(w, (int, float)) \
+                    and w > rep_worst.get(rep, (-1.0, None))[0]:
+                rep_worst[rep] = (float(w), f.get("worst_trace"))
         for rep, h in rep_hists.items():
-            replicas.append({
+            row = {
                 "replica": rep,
                 "count": h.count,
                 "p95_ms": h.quantile_ms(0.95),
                 "max_ms": (round(h.max_us / 1000.0, 4)
                            if h.max_us is not None else None),
-            })
+            }
+            worst = rep_worst.get(rep)
+            if worst is not None and worst[1]:
+                # the drill-down id: `obs journey <worst_trace>`
+                row["worst_trace"] = worst[1]
+            replicas.append(row)
         replicas.sort(key=lambda r: -(r["p95_ms"] or 0.0))
 
         return {
@@ -781,9 +831,11 @@ def render(report: dict) -> str:
     if reps:
         lines.append("  worst replica apply-lag:")
         for r in reps[:5]:
+            tr = r.get("worst_trace")
             lines.append(
                 f"    {r['replica']}: p95 {r['p95_ms']:g} ms "
-                f"(max {r['max_ms']:g}, n={r['count']})")
+                f"(max {r['max_ms']:g}, n={r['count']})"
+                + (f"  worst trace {tr}" if tr else ""))
         if len(reps) > 5:
             lines.append(f"    ... {len(reps) - 5} more replica(s)")
     return "\n".join(lines)

@@ -64,6 +64,7 @@ from typing import Callable, Dict, Iterable, List, Optional, Tuple
 from . import core
 from .costmodel import CostReducer
 from .fleet import FleetReducer
+from .journey import JourneyFold
 
 __all__ = [
     "LiveFold",
@@ -94,16 +95,21 @@ class LiveFold:
     recency, waves/sec, token-headroom minima. Pure read side: safe
     to run with obs off (a monitor tailing a foreign sidecar)."""
 
-    __slots__ = ("fleet", "cost", "first_ts_us", "last_ts_us",
-                 "last_seen_us", "_wave_ts", "headroom_min",
-                 "headroom_last", "heartbeat", "serve_gauges",
-                 "_shed_ts", "shed_total", "serve_ticks",
-                 "net_gauges", "net_counts", "_reconnect_ts",
-                 "disk_faults", "journal_torn")
+    __slots__ = ("fleet", "cost", "journeys", "first_ts_us",
+                 "last_ts_us", "last_seen_us", "_wave_ts",
+                 "headroom_min", "headroom_last", "heartbeat",
+                 "serve_gauges", "_shed_ts", "shed_total",
+                 "serve_ticks", "net_gauges", "net_counts",
+                 "_reconnect_ts", "disk_faults", "journal_torn")
 
     def __init__(self):
         self.fleet = FleetReducer()
         self.cost = CostReducer()
+        # PR 19, the distributed-tracing axes: streaming journey
+        # reconstruction with tail-based exemplar retention — only
+        # SLO-breaching (or orphaned) journeys keep full hop detail;
+        # everything else folds into the per-edge histograms
+        self.journeys = JourneyFold(slo_ms=100.0)
         self.first_ts_us: Optional[int] = None
         self.last_ts_us: Optional[int] = None
         # event name -> newest ts_us (the absence rules' input)
@@ -146,6 +152,7 @@ class LiveFold:
     def feed(self, e: dict) -> None:
         self.fleet.feed(e)
         self.cost.feed(e)
+        self.journeys.feed(e)
         ts = e.get("ts_us")
         if isinstance(ts, (int, float)):
             ts = int(ts)
@@ -356,6 +363,7 @@ class LiveFold:
                 "outbound_depth": self._net_outbound(),
                 "connections": self.net_gauges.get("connections"),
             },
+            "journey": self.journeys.summary(),
             "ages_s": self.ages_s(now),
         }
         if self.cost.waves:

@@ -167,6 +167,21 @@ def render(snap: dict, alerts: List[dict], paths: List[str],
             f"{net.get('dup_frames', 0)} dup frame(s) "
             f"+ {net.get('dup_ops_suppressed', 0)} op(s) suppressed, "
             f"outbound {_g(net.get('outbound_depth'))}")
+    jy = snap.get("journey") or {}
+    if jy.get("active"):
+        line = (
+            f"  journeys: {jy.get('traces', 0)} trace(s) "
+            f"({jy.get('complete', 0)} complete, "
+            f"{jy.get('shed', 0)} shed, "
+            f"{jy.get('inflight', 0)} in flight), "
+            f"{jy.get('orphan_hops', 0)} orphan hop(s); "
+            f"mint→converged p50 {_g(jy.get('total_p50_ms'))} ms "
+            f"p99 {_g(jy.get('total_p99_ms'))}")
+        lines.append(line)
+        if jy.get("worst_trace"):
+            lines.append(
+                f"    worst: {_g(jy.get('worst_total_ms'))} ms — "
+                f"`obs journey {jy['worst_trace']}`")
     hb = snap.get("heartbeat")
     if hb:
         hb_age = ages.get("run.heartbeat")
@@ -250,6 +265,15 @@ _PROM_METRICS = (
     ("cause_tpu_live_net_dup_ops_total", "net.dup_ops_suppressed",
      "counter"),
     ("cause_tpu_live_net_outbound_depth", "net.outbound_depth",
+     "gauge"),
+    ("cause_tpu_live_journey_traces_total", "journey.traces",
+     "counter"),
+    ("cause_tpu_live_journey_complete_total", "journey.complete",
+     "counter"),
+    ("cause_tpu_live_journey_inflight", "journey.inflight", "gauge"),
+    ("cause_tpu_live_journey_orphan_hops_total",
+     "journey.orphan_hops", "counter"),
+    ("cause_tpu_live_journey_p99_ms", "journey.total_p99_ms",
      "gauge"),
     ("cause_tpu_live_alerts_total", "alerts_total", "counter"),
 )

@@ -49,6 +49,7 @@ import numpy as np
 
 from .. import chaos as _chaos
 from .. import obs
+from ..obs import xtrace
 
 __all__ = ["BatchScheduler"]
 
@@ -63,11 +64,18 @@ class BatchScheduler:
         self.last_buckets = 0
         self.last_batch_rows = 0
         self.last_fallbacks = 0
+        # tick-scoped {uuid: [trace ids]} for per-tenant wave hops
+        self._traces_by_uuid: Dict[str, list] = {}
 
-    def wave_fleet(self, sessions) -> Dict[str, np.ndarray]:
+    def wave_fleet(self, sessions,
+                   traces_by_uuid=None) -> Dict[str, np.ndarray]:
         """One batched wave over ``{uuid: FleetSession}``: every
         session ends wave-current; returns ``{uuid: digest array}``
-        bit-identical to per-tenant ``wave()`` calls."""
+        bit-identical to per-tenant ``wave()`` calls.
+        ``traces_by_uuid`` (PR 19, obs-on ticks) maps tenants to the
+        trace ids riding this tick so each fused bucket span fans out
+        per-tenant "wave" child hops."""
+        self._traces_by_uuid = traces_by_uuid or {}
         digests: Dict[str, np.ndarray] = {}
         fallback: List[str] = []
         buckets: Dict[int, list] = {}
@@ -93,6 +101,9 @@ class BatchScheduler:
             # recovery evidence rode the frontier drop that put the
             # tenant here (update-level degrade, abandon_frontier)
             digests[uuid] = sessions[uuid].wave()
+            if obs.enabled():
+                for tr in self._traces_by_uuid.get(uuid, ()):
+                    xtrace.hop("wave", tr, uuid=uuid, path="full")
         self.last_fallbacks = len(fallback)
         return digests
 
@@ -184,6 +195,14 @@ class BatchScheduler:
             digests[uuid] = sess.complete_window(
                 rank_np[sl], vis_np[sl], out[sl],
                 starts[sl], counts[sl])
+            if obs.enabled():
+                # the bucket span fans out per-tenant child hops:
+                # each trace's "wave" hop names the fused dispatch
+                # (bucket + rows) that actually served it
+                for tr in self._traces_by_uuid.get(uuid, ()):
+                    xtrace.hop("wave", tr, uuid=uuid,
+                               path="batched", bucket=int(wcap),
+                               batch_rows=n_pad)
         if obs.enabled():
             from ..obs import costmodel as _cm
             from ..obs import devprof

@@ -56,6 +56,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 from .. import obs
 from .. import sync
 from ..collections import shared as s
+from ..obs import xtrace
 
 __all__ = ["Admission", "IngestJournal", "IngestQueue"]
 
@@ -143,10 +144,14 @@ class IngestJournal:
                     self.skipped += 1
 
     def append(self, uuid: str, site: str, items: list,
-               ts_us: Optional[int] = None) -> int:
+               ts_us: Optional[int] = None,
+               trace: Optional[list] = None) -> int:
         """Durably record one admitted batch; returns its seq. The
         write happens BEFORE the queue acknowledges admission — the
-        no-admitted-op-lost contract hangs on that order."""
+        no-admitted-op-lost contract hangs on that order. ``trace``
+        (a list of trace ids, PR 19) is recorded only when given —
+        obs-on callers pass it so replay re-links the journey; obs-off
+        journal bytes stay pinned (scripts/obs_off_pin.py)."""
         with self._lock:
             self._seq += 1
             seq = self._seq
@@ -154,6 +159,8 @@ class IngestJournal:
                    "items": items,
                    "ts_us": int(ts_us if ts_us is not None
                                 else time.time_ns() // 1000)}
+            if trace:
+                rec["trace"] = list(trace)
             self._fh.write(json.dumps(rec) + "\n")
             self._fh.flush()
         return seq
@@ -173,15 +180,19 @@ class IngestJournal:
 
 
 class _Entry:
-    __slots__ = ("uuid", "site", "items", "ops", "seq", "ts_us")
+    __slots__ = ("uuid", "site", "items", "ops", "seq", "ts_us",
+                 "traces")
 
-    def __init__(self, uuid, site, items, ops, seq, ts_us):
+    def __init__(self, uuid, site, items, ops, seq, ts_us,
+                 traces=None):
         self.uuid = uuid
         self.site = site
         self.items = items
         self.ops = ops
         self.seq = seq
         self.ts_us = ts_us
+        # trace ids riding this batch (PR 19; None when obs is off)
+        self.traces = traces
 
 
 class IngestQueue:
@@ -307,7 +318,8 @@ class IngestQueue:
         return round(1000.0 * backlog / self._drain_ops_per_s, 3)
 
     def _shed(self, rung: str, reason: str, uuid: str, site: str,
-              ops: int, retry_after_ms: Optional[float] = None) -> None:
+              ops: int, retry_after_ms: Optional[float] = None,
+              traces=None) -> None:
         """The one funnel every shed goes through: stats + the
         evidenced ``serve.shed`` event. Called under the lock; the
         event emission is the obs no-op funnel (safe there)."""
@@ -324,16 +336,24 @@ class IngestQueue:
             if retry_after_ms is not None:
                 fields["retry_after_ms"] = retry_after_ms
             obs.event("serve.shed", **fields)
+            # a shed ENDS the batch's journey — record where it died
+            for tr in (traces or ()):
+                xtrace.hop("shed", tr, rung=rung, reason=reason,
+                           uuid=uuid, site=site)
 
     # ------------------------------------------------------ admission
 
     def offer(self, uuid: str, site: str, items: list,
               crc: Optional[int] = None,
-              now_us: Optional[int] = None) -> Admission:
+              now_us: Optional[int] = None,
+              traces: Optional[list] = None) -> Admission:
         """Offer one per-site delta batch (``serde.encode_node_items``
         wire form) for tenant ``uuid``. See the module docstring for
         the refusal ladder. Validation runs OUTSIDE the queue lock
-        (it is O(ops) host work)."""
+        (it is O(ops) host work). ``traces`` (PR 19) carries the
+        batch's trace ids from an upstream hop (the wire); with obs on
+        and none given, admission MINTS one — every admitted batch has
+        a causal identity."""
         uuid, site = str(uuid), str(site)
         now = self._now_us(now_us)
         # --- the trust boundary (poison never enters the queue)
@@ -372,11 +392,30 @@ class IngestQueue:
         ops = len(items)
         if ops == 0:
             return Admission(True, seq=0)  # nothing to admit
+        if obs.enabled() and not traces:
+            # the Admission.offer mint point: a batch arriving with
+            # no upstream context (local producer, not the wire).
+            # Ops already bound in-process (the mutation funnel's
+            # mint) continue THEIR traces — minting over them would
+            # split one journey into two half-chains; only genuinely
+            # unattributed batches get their causal identity here.
+            # Past the trust boundary on purpose — poison earns no
+            # trace.
+            existing = xtrace.traces_of(it[0] for it in items)
+            if existing:
+                traces = existing[:16]
+            else:
+                tr = xtrace.new_trace()
+                xtrace.hop("mint", tr, parent="", source="offer",
+                           uuid=uuid, site=site, ops=ops)
+                xtrace.bind_ops(tr, [it[0] for it in items])
+                traces = [tr]
         with self._lock:
             if self._closed:
                 # drain already started: admission is closed, the
                 # producer retries against the restarted service
-                self._shed("reject", "closed", uuid, site, ops)
+                self._shed("reject", "closed", uuid, site, ops,
+                           traces=traces)
                 return Admission(False, rung="reject", reason="closed")
             retry = self._retry_after_ms(ops)
             if (self.deadline_ms is not None and retry is not None
@@ -384,14 +423,14 @@ class IngestQueue:
                 # deadline-aware admission: the op would sit in the
                 # queue past its own deadline — shed at the door
                 self._shed("reject", "deadline", uuid, site, ops,
-                           retry_after_ms=retry)
+                           retry_after_ms=retry, traces=traces)
                 return Admission(False, rung="reject",
                                  reason="deadline",
                                  retry_after_ms=retry)
             if self._depth + ops > self.max_ops:
                 # rung 2: at capacity — reject with the hint
                 self._shed("reject", "capacity", uuid, site, ops,
-                           retry_after_ms=retry)
+                           retry_after_ms=retry, traces=traces)
                 return Admission(False, rung="reject",
                                  reason="capacity",
                                  retry_after_ms=retry)
@@ -413,17 +452,21 @@ class IngestQueue:
                 elif len(self._deferred) >= self.defer_max:
                     old = self._deferred.popleft()
                     self._shed("drop_oldest", "defer-overflow",
-                               old.uuid, old.site, old.ops)
+                               old.uuid, old.site, old.ops,
+                               traces=old.traces)
                 self._deferred.append(
-                    _Entry(uuid, site, items, ops, -1, now))
+                    _Entry(uuid, site, items, ops, -1, now,
+                           traces=traces))
                 self._shed("defer", "cold-tenant", uuid, site, ops,
-                           retry_after_ms=retry)
+                           retry_after_ms=retry, traces=traces)
                 return Admission(False, rung="defer",
                                  reason="cold-tenant",
                                  retry_after_ms=retry)
-            return self._admit_locked(uuid, site, items, ops, now)
+            return self._admit_locked(uuid, site, items, ops, now,
+                                      traces=traces)
 
-    def _admit_locked(self, uuid, site, items, ops, now) -> Admission:
+    def _admit_locked(self, uuid, site, items, ops, now,
+                      traces=None) -> Admission:
         # a site's offers are cumulative: admitting this one makes any
         # parked older entry from the same (uuid, site) a strict
         # subset — drop it, or promotion would re-journal and
@@ -438,9 +481,14 @@ class IngestQueue:
         # refuses the offer with a retry hint and the producer
         # re-offers once storage recovers (zero ADMITTED ops lost:
         # this op was never admitted)
+        if obs.enabled():
+            for tr in (traces or ()):
+                xtrace.hop("admit", tr, uuid=uuid, site=site, ops=ops,
+                           depth=self._depth)
         if self.journal is not None:
             try:
-                seq = self.journal.append(uuid, site, items, ts_us=now)
+                seq = self.journal.append(uuid, site, items, ts_us=now,
+                                          trace=traces)
             except (s.CausalError, OSError) as e:
                 causes = getattr(e, "info", {}).get("causes", ())
                 reason = next(iter(causes), "journal-error")
@@ -448,13 +496,18 @@ class IngestQueue:
                 if retry is None:
                     retry = _DURABILITY_RETRY_MS
                 self._shed("durability", reason, uuid, site, ops,
-                           retry_after_ms=retry)
+                           retry_after_ms=retry, traces=traces)
                 return Admission(False, rung="durability",
                                  reason=reason, retry_after_ms=retry)
+            if obs.enabled():
+                for tr in (traces or ()):
+                    xtrace.hop("journal", tr, uuid=uuid, site=site,
+                               seq=seq)
         else:
             self._seq += 1
             seq = self._seq
-        self._q.append(_Entry(uuid, site, items, ops, seq, now))
+        self._q.append(_Entry(uuid, site, items, ops, seq, now,
+                              traces=traces))
         self._depth += ops
         self._touch_hot(uuid, ops, now)
         self.stats["admitted_ops"] += ops
@@ -485,7 +538,7 @@ class IngestQueue:
             while self._deferred:
                 d = self._deferred.popleft()
                 self._shed("drop_oldest", "drain-stranded",
-                           d.uuid, d.site, d.ops)
+                           d.uuid, d.site, d.ops, traces=d.traces)
                 n += 1
         return n
 
@@ -532,7 +585,7 @@ class IngestQueue:
                     <= self.max_ops:
                 d = self._deferred.popleft()
                 adm = self._admit_locked(d.uuid, d.site, d.items,
-                                         d.ops, now)
+                                         d.ops, now, traces=d.traces)
                 self.stats["deferred_promoted"] += 1
                 if obs.enabled():
                     obs.counter("serve.deferred_promoted").inc()

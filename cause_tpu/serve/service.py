@@ -51,6 +51,7 @@ from .. import obs
 from .. import serde
 from .. import sync
 from ..collections import shared as s
+from ..obs import xtrace
 from .batch import BatchScheduler
 from .controller import BatchController
 from .ingest import IngestQueue
@@ -275,6 +276,20 @@ class SyncService:
                               ops=sum(e.ops for e in batch))
                 continue
             known.append((uuid, batch))
+        # trace continuation (PR 19): every drained entry's traces get
+        # a "tick" hop here and the per-tenant map rides into the
+        # scheduler so the fused bucket span can fan out per-tenant
+        # "wave" child hops
+        traces_by_uuid: Dict[str, List[str]] = {}
+        if obs.enabled():
+            for uuid, batch in known:
+                seen = traces_by_uuid.setdefault(uuid, [])
+                for e in batch:
+                    for tr in (e.traces or ()):
+                        xtrace.hop("tick", tr, uuid=uuid, seq=e.seq,
+                                   ops=e.ops, tick=self.ticks)
+                        if tr not in seen:
+                            seen.append(tr)
         # the tick's device dispatch count, read from the costmodel
         # counter (not inferred): the batched tick's whole claim is
         # that this collapses from O(#tenants) to O(#buckets)
@@ -298,13 +313,18 @@ class SyncService:
                     self._apply_batches(uuid, batch,
                                         sess=group.get(uuid),
                                         wave=False)
-                self._scheduler.wave_fleet(group)
+                self._scheduler.wave_fleet(
+                    group, traces_by_uuid=traces_by_uuid)
                 buckets += self._scheduler.last_buckets
                 batch_rows += self._scheduler.last_batch_rows
                 fallbacks += self._scheduler.last_fallbacks
         else:
             for uuid, batch in known:
                 self._apply_batches(uuid, batch)
+                if obs.enabled():
+                    for tr in traces_by_uuid.get(uuid, ()):
+                        xtrace.hop("wave", tr, uuid=uuid,
+                                   path="per-tenant")
         wave_dispatches = (obs.counter("costmodel.dispatches").value
                            - disp0) if obs.enabled() else 0
         snap = None
@@ -690,13 +710,40 @@ class SyncService:
                 continue
             from .ingest import _Entry
 
+            # re-link the journey (PR 19): a journal row written by an
+            # obs-on process carries its batch's trace ids — the
+            # restored process continues those chains ("replay" hop,
+            # ops re-bound for the lag join) instead of orphaning them
+            traces = None
+            if obs.enabled():
+                raw = e.get("trace")
+                if isinstance(raw, list):
+                    traces = [str(tr) for tr in raw[:16]
+                              if isinstance(tr, str) and tr]
+                for tr in (traces or ()):
+                    xtrace.hop("replay", tr, uuid=uuid,
+                               seq=int(e["seq"]))
+                    xtrace.bind_ops(
+                        tr, [tuple(it[0]) for it in items])
             by_tenant.setdefault(uuid, []).append(
                 _Entry(uuid, str(e.get("site")), items, len(items),
-                       int(e["seq"]), int(e.get("ts_us") or 0)))
+                       int(e["seq"]), int(e.get("ts_us") or 0),
+                       traces=traces))
         ops = 0
         for uuid, batch in by_tenant.items():
             self._apply_batches(uuid, batch)
             ops += sum(x.ops for x in batch)
+            if obs.enabled():
+                # replayed entries never re-enter the queue (no tick
+                # hop): the replay's own per-tenant wave is the
+                # journey's next edge after "replay"
+                seen: List[str] = []
+                for x in batch:
+                    for tr in (x.traces or ()):
+                        if tr not in seen:
+                            seen.append(tr)
+                            xtrace.hop("wave", tr, uuid=uuid,
+                                       path="replay")
         # torn/corrupt lines were COUNTED by the scan but invisible to
         # the dashboard until PR 15: any skip on a replay is evidence
         # (a torn tail is expected after a crash; CRC corruption never

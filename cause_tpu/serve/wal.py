@@ -333,13 +333,16 @@ class WriteAheadLog:
     # -------------------------------------------------------- append
 
     def append(self, uuid: str, site: str, items: list,
-               ts_us: Optional[int] = None) -> int:
+               ts_us: Optional[int] = None,
+               trace: Optional[list] = None) -> int:
         """Durably record one admitted batch; returns its seq. Same
         contract as ``IngestJournal.append`` (write BEFORE the queue
         acknowledges), plus the disk chaos seams: a failed append
         raises ``CausalError`` naming the cause — the caller must NOT
         acknowledge (admission's durability rung refuses the offer)
-        and the seq is not consumed."""
+        and the seq is not consumed. ``trace`` (PR 19): trace ids
+        recorded in the row only when given, so replay re-links the
+        journey; obs-off segment bytes stay pinned."""
         with self._lock:
             self._maybe_rotate_locked()
             seq = self._seq + 1
@@ -347,6 +350,8 @@ class WriteAheadLog:
                    "items": items,
                    "ts_us": int(ts_us if ts_us is not None
                                 else time.time_ns() // 1000)}
+            if trace:
+                rec["trace"] = list(trace)
             body = json.dumps(rec)
             crc_hex = format(
                 zlib.crc32(body.encode("utf-8")) & 0xFFFFFFFF, "08x")

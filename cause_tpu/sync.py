@@ -43,6 +43,7 @@ from . import serde
 from .obs import costmodel as _cm
 from .obs import lag as _lag
 from .obs import semantic as _sem
+from .obs import xtrace as _xtrace
 
 __all__ = [
     "version_vector",
@@ -161,6 +162,13 @@ def apply_delta(handle, nodes: dict, _count_as_delta: bool = True):
         # are stamped now — ingest IS their local creation time
         _lag.ops_applied(handle.ct.uuid, nodes.keys(),
                          replica=handle.ct.site_id)
+        # journey hop (PR 19): the delta's ops just became visible on
+        # this replica — one "apply" hop per distinct trace riding
+        # the batch (remote-apply in the per-hop SLO decomposition)
+        for tr in _xtrace.traces_of(nodes.keys()):
+            _xtrace.hop("apply", tr, uuid=str(handle.ct.uuid),
+                        replica=str(handle.ct.site_id),
+                        ops=len(nodes))
     return merged
 
 

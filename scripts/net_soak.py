@@ -76,6 +76,7 @@ from cause_tpu.serve import (IngestJournal, IngestQueue,  # noqa: E402
 
 EXIT_CONVERGENCE = 4
 EXIT_UNDETECTED = 5
+EXIT_JOURNEY = 6
 
 
 class ClientDriver(threading.Thread):
@@ -256,6 +257,16 @@ def main():
                     help="per-frame overhead bench frames on the "
                          "healthy link (0 disables)")
     ap.add_argument("--obs-out", required=True)
+    ap.add_argument("--proc-clients", type=int, default=0,
+                    help="additional client endpoints as REAL child "
+                         "interpreters (one tenant each), each "
+                         "writing its own obs stream to "
+                         "<obs-out>.pK — the per-process evidence "
+                         "`obs journey` merges; a clean run gates "
+                         "every child trace reconstructing complete "
+                         "(zero orphan hops) across pids (exit 6)")
+    ap.add_argument("--proc-ops", type=int, default=6,
+                    help="ops each --proc-clients child mints")
     ap.add_argument("--state-dir", default=None)
     args = ap.parse_args()
 
@@ -271,13 +282,22 @@ def main():
     if os.path.exists(journal_path):
         os.unlink(journal_path)
 
-    capacity = args.clients
+    capacity = args.clients + args.proc_clients
     queue = IngestQueue(max_ops=args.max_ops, defer_frac=1.0,
                         journal=IngestJournal(journal_path))
     svc = SyncService(queue,
                       residency=ResidencyManager(capacity=capacity),
                       checkpoint_dir=ckpt_dir, d_max=args.d_max)
     uuids, pairs_init = _mk_tenants(svc, args.clients, args.doc)
+    proc_uuids = []
+    if args.proc_clients:
+        # out-of-process endpoints get tenants of their own: their
+        # ops ride the SAME oracle/digest gates (appended to uuids),
+        # their traces the journey gate below
+        proc_uuids, proc_pairs = _mk_tenants(svc, args.proc_clients,
+                                             args.doc)
+        uuids = uuids + proc_uuids
+        pairs_init.update(proc_pairs)
     srv = ReplicationServer(svc).start()
     port = srv.port
     print(f"net soak: {args.clients} client(s)/tenant(s) on "
@@ -312,6 +332,27 @@ def main():
                for i in range(args.clients)]
     for d in drivers:
         d.start()
+
+    # ---- genuinely separate processes: the per-host evidence shape.
+    # Each child interpreter (journey_smoke's --child half) dials in
+    # over loopback, mints ONE traced batch on its own tenant, pumps
+    # until its outbound drains (reconnect ladder included — chaos is
+    # armed), writes its OWN obs stream, and hands its trace id back
+    # on stdout for the journey gate.
+    import subprocess
+    proc_streams = [f"{args.obs_out}.p{k + 1}"
+                    for k in range(args.proc_clients)]
+    for p in proc_streams:
+        if os.path.exists(p):
+            os.unlink(p)
+    procs = [subprocess.Popen(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "journey_smoke.py"),
+         "--child", "--port", str(port), "--uuid", proc_uuids[k],
+         "--ops", str(args.proc_ops), "--obs-out", proc_streams[k]],
+        stdout=subprocess.PIPE, text=True)
+        for k in range(args.proc_clients)]
 
     # ---- the timed run (main thread = the serve tick loop) ---------
     retired_server_stats = []
@@ -356,6 +397,18 @@ def main():
         print("net soak: CLIENT DRIVER FAILED: "
               + "; ".join(gen_errors), flush=True)
         return 2
+    proc_handoffs = []
+    for k, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=40.0)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out = ""
+        if p.returncode != 0:
+            print(f"net soak: PROC CLIENT p{k + 1} FAILED "
+                  f"(rc={p.returncode}): {out!r}", flush=True)
+            return 2
+        proc_handoffs.append(json.loads(out.strip().splitlines()[-1]))
 
     # ---- drain: every client flushes, the service flushes. ONE tick
     # per iteration so client pumps interleave with the queue drain —
@@ -516,6 +569,58 @@ def main():
             return EXIT_UNDETECTED
     assert digests  # every tenant digest fetched before srv.stop
 
+    # (3) cross-process journeys reconstruct complete: every child
+    # interpreter's trace spans both pids with zero orphan hops in
+    # the MERGED per-process streams — exactly what `obs journey`
+    # gives an operator holding the per-host sidecars
+    journey_summary = None
+    if args.proc_clients:
+        from cause_tpu.obs.journey import JourneyFold
+        from cause_tpu.obs.perfetto import load_streams
+
+        jfold = JourneyFold(retain_all=True)
+        jfold.feed_many(load_streams([args.obs_out] + proc_streams))
+        jrep = jfold.report()
+        jfails = []
+        for k, hand in enumerate(proc_handoffs):
+            if hand["accounted"] != args.proc_ops:
+                jfails.append(f"p{k + 1} accounted "
+                              f"{hand['accounted']}/{args.proc_ops}")
+            j = jfold.journey(hand["trace"])
+            if j is None:
+                jfails.append(f"p{k + 1} trace {hand['trace']} "
+                              f"absent from merged streams")
+            elif not j["complete"] or j["orphans"] \
+                    or len(j["pids"]) < 2:
+                jfails.append(
+                    f"p{k + 1} trace {hand['trace']}: "
+                    f"complete={j['complete']} "
+                    f"orphans={j['orphans']} pids={j['pids']}")
+        if jrep["orphan_hops"]:
+            jfails.append(f"{jrep['orphan_hops']} orphan hop(s) "
+                          f"fleet-wide")
+        if not jrep["clock"]["edges"]:
+            jfails.append("no clock edge measured")
+        if any(j_["orphans"] for j_ in jfold.worst(5)):
+            jfails.append("a worst-5 (p99 offender) journey has "
+                          "orphan hops")
+        if jfails:
+            print("net soak: JOURNEY GATE FAILED: "
+                  + "; ".join(jfails), flush=True)
+            return EXIT_JOURNEY
+        journey_summary = {
+            "proc_clients": args.proc_clients,
+            "streams": 1 + len(proc_streams),
+            "traces": jrep["traces"],
+            "complete": jrep["complete"],
+            "orphan_hops": jrep["orphan_hops"],
+            "clock_edges": len(jrep["clock"]["edges"]),
+            "total_p99_ms": jrep["total"]["p99_ms"],
+            "proc_traces": [h["trace"] for h in proc_handoffs],
+        }
+        print("net soak: journey gate clean — "
+              + json.dumps(journey_summary), flush=True)
+
     try:
         row = ledger.ingest_record(
             {
@@ -534,7 +639,9 @@ def main():
             kind="net",
             extra={"net": {k: v for k, v in summary.items()
                            if k not in ("oracle_mismatches",
-                                        "stuck_clients")}},
+                                        "stuck_clients")},
+                   **({"journey": journey_summary}
+                      if journey_summary else {})},
         )
         print(f"net soak: ledger row ({row['platform']}) -> "
               f"{ledger.default_path()}", flush=True)

@@ -1,0 +1,43 @@
+"""Known-bad XTR001 fixture: cross-process tracing APIs on a traced
+path. Only the unguarded calls gate — the OBS003-OBS007 guard
+spellings (nested if, xtrace.enabled, aliased import, early return)
+are sanctioned here too."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu.obs import xtrace
+from cause_tpu.obs import xtrace as _xtrace
+from cause_tpu.obs import enabled as _obs_enabled
+
+
+@jax.jit
+def traced(x):
+    xtrace.hop("mint", "t0", parent="")               # XTR001: unguarded
+    if obs.enabled():
+        tr = xtrace.new_trace()                       # guarded: fine
+        xtrace.bind_ops(tr, [(1, "s", 0)])
+    if xtrace.enabled():
+        # the module's own guard spelling must not be flagged as an
+        # unguarded xtrace call itself
+        xtrace.hop("send", "t0")
+    if _obs_enabled():
+        # the aliased guard + aliased module spellings are fine
+        _xtrace.clock_sample({"ts_us": 1, "pid": 2}, 0, 1, via="hello")
+    return x * 2
+
+
+@jax.jit
+def traced_early_return(x):
+    # early-return guard: nothing below runs with obs off
+    if not obs.enabled():
+        return x
+    xtrace.wire_context("t0", "s0")
+    return x * 2
+
+
+@jax.jit
+def traced_qualified(x):
+    # a generic verb through the module qualifier still gates
+    _xtrace.reset()                                   # XTR001: unguarded
+    return x + 1

@@ -174,6 +174,22 @@ def test_live_unguarded_call_on_traced_path():
     assert rules_of(res) == ["OBS007"]
 
 
+def test_xtrace_unguarded_call_on_traced_path():
+    """XTR001 (PR-19): the cross-process tracer takes the span-
+    registry lock, mints span ids and assembles hop/clock payloads
+    when obs is on — jit-reachable code must gate it behind
+    obs.enabled(). Exactly two findings — the plain unguarded hop and
+    a generic verb reached through the module qualifier; every
+    OBS003-007 guard spelling (nested if, xtrace.enabled, aliased
+    module, early return) is sanctioned."""
+    res = run_api(os.path.join(FIX, "xtrace_caller_bad.py"))
+    xtr = [f for f in res.findings if f.rule == "XTR001"]
+    assert len(xtr) == 2, [f.message for f in xtr]
+    assert "hop" in xtr[0].message
+    assert "reset" in xtr[1].message
+    assert rules_of(res) == ["XTR001"]
+
+
 def test_chaos_unguarded_call_on_traced_path():
     """CHS001 (PR-11): chaos-engine hooks advance seeded RNG streams
     under the engine lock and recovery telemetry assembles event
@@ -496,6 +512,7 @@ def test_cli_exit_codes():
     "obs_caller_bad.py", "devprof_caller_bad.py",
     "semantic_caller_bad.py", "costmodel_caller_bad.py",
     "lag_caller_bad.py", "live_caller_bad.py",
+    "xtrace_caller_bad.py",
     "chaos_caller_bad.py", "serve_caller_bad.py",
     "batch_caller_bad.py", "net_caller_bad.py",
     "wal_caller_bad.py", "lca_bad.py",
@@ -514,7 +531,8 @@ def test_cli_list_rules():
     assert out.returncode == 0
     for rid in ("TID001", "TID002", "TID003", "JPH001", "JPH006",
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
-                "OBS006", "OBS007", "CHS001", "SRV001", "NET001",
+                "OBS006", "OBS007", "XTR001", "CHS001", "SRV001",
+                "NET001",
                 "DSK001", "LCA001", "GEN001", "LCK001", "LCK002",
                 "LCK003", "LCK004", "DUR001", "DUR002", "DUR003",
                 "DUR004", "EVD001"):

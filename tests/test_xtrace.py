@@ -1,0 +1,528 @@
+"""PR 19: distributed tracing — cross-process trace propagation,
+clock-skew-corrected journey reconstruction, per-hop SLO
+decomposition.
+
+Pins the tentpole's contracts:
+
+- **obs-off is byte-zero** — with obs unset, every xtrace API is
+  inert AND the wire frames / journal bytes a serving stack produces
+  are byte-identical to the pre-PR capture
+  (``measurements/obs_off_pin_r19.json``, checked via the real
+  loopback protocol in ``scripts/obs_off_pin.py``);
+- **wire compatibility both ways** — an old (ctx-less) client against
+  a new obs-on server admits normally, and a new obs-on client
+  against an old server (no reply stamps, ctx ignored) replicates
+  normally with zero clock samples;
+- **journeys survive process boundaries** — restore replays re-link
+  the journal's trace ids, and skewed per-host clocks are corrected
+  onto one timebase by the hello/ping offset samples before causal
+  ordering;
+- **the drill-down chain closes** — ``obs lag`` worst-offender rows
+  carry the exact trace id ``obs journey`` accepts.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import cause_tpu as c
+from cause_tpu import chaos, obs, serde, sync
+from cause_tpu.collections import clist as c_list
+from cause_tpu.collections.clist import CausalList
+from cause_tpu.ids import new_site_id
+from cause_tpu.net import NetClient, ReplicationServer, transport
+from cause_tpu.obs import lag, xtrace
+from cause_tpu.obs.journey import JourneyFold, journey_report
+from cause_tpu.serve import (IngestJournal, IngestQueue,
+                             ServiceCrashed, SyncService)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state(monkeypatch):
+    for k in ("CAUSE_TPU_CHAOS", "CAUSE_TPU_OBS", "CAUSE_TPU_OBS_OUT"):
+        monkeypatch.delenv(k, raising=False)
+    chaos.reset()
+    obs.reset()
+    sync.quarantine_reset()
+    yield
+    chaos.reset()
+    obs.reset()
+    sync.quarantine_reset()
+
+
+def _events(name=None):
+    evs = [e for e in obs.events() if e.get("ev") == "event"]
+    if name is None:
+        return evs
+    return [e for e in evs if e.get("name") == name]
+
+
+def _base(n=12):
+    base = CausalList(c_list.weave(
+        c.clist(weaver="jax").extend(["w"] * n).ct
+    ))
+    base.ct.lanes.segments()
+    return base
+
+
+def _pair(base):
+    a = CausalList(base.ct.evolve(site_id=new_site_id())).conj("A")
+    b = CausalList(base.ct.evolve(site_id=new_site_id())).conj("B")
+    return a, b
+
+
+def _service(tmp_path):
+    jr = IngestJournal(str(tmp_path / "wal.jsonl"))
+    q = IngestQueue(max_ops=4096, defer_frac=1.0, journal=jr)
+    svc = SyncService(q, checkpoint_dir=str(tmp_path / "ckpt"),
+                      d_max=16)
+    a, b = _pair(_base())
+    uuid = svc.add_tenant(a, b)
+    return svc, uuid
+
+
+def _mint(site, n, start_ts=1000):
+    out = []
+    last = c.root_id
+    ts = start_ts
+    for _ in range(n):
+        ts += 1
+        nid = (ts, site, 0)
+        out.append((nid, last, f"op{ts}"))
+        last = nid
+    return out
+
+
+def _hop_names(j):
+    return [h["hop"] for h in j["hops"]]
+
+
+# ----------------------------------------------------- obs-off is zero
+
+
+def test_obs_off_pin_byte_identity():
+    """THE invariance pin: with obs unset, the wire frames and journal
+    bytes of a real loopback serving exchange are byte-identical to
+    the pre-PR capture — no ctx keys, no reply stamps, no trace
+    fields. Subprocess: a clean env with no obs residue."""
+    env = dict(os.environ)
+    for k in ("CAUSE_TPU_OBS", "CAUSE_TPU_OBS_OUT"):
+        env.pop(k, None)
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "obs_off_pin.py"),
+         "--check"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=120)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "clean" in res.stdout
+
+
+def test_xtrace_apis_inert_when_off():
+    assert not obs.enabled()
+    assert xtrace.new_trace() is None
+    assert xtrace.hop("mint", "t0", parent="") is None
+    assert xtrace.wire_context("t", "s") is None
+    assert xtrace.continue_from({"t": "a", "s": "b"}) == (None, None)
+    xtrace.bind_ops("t", [(1, "s", 0)])
+    assert xtrace.trace_of((1, "s", 0)) is None
+    assert xtrace.traces_of([(1, "s", 0)]) == []
+    assert xtrace.clock_sample({"ts_us": 1, "pid": 2}, 0, 1) is None
+    assert obs.events() == []
+
+
+# ------------------------------------------------------- the write side
+
+
+def test_hop_chain_and_cross_thread_parent():
+    obs.configure(enabled=True)
+    tr = xtrace.new_trace()
+    assert isinstance(tr, str) and len(tr) == 16
+    s_mint = xtrace.hop("mint", tr, parent="", ops=3)
+    # parent=None links onto the trace's last in-process span — the
+    # queue-entry handoff between the admission and tick threads
+    s_admit = xtrace.hop("admit", tr)
+    s_tick = xtrace.hop("tick", tr)
+    evs = _events("xtrace.hop")
+    assert [e["fields"]["hop"] for e in evs] == ["mint", "admit",
+                                                "tick"]
+    assert evs[0]["fields"]["parent"] == ""
+    assert evs[1]["fields"]["parent"] == s_mint
+    assert evs[2]["fields"]["parent"] == s_admit
+    assert xtrace.last_span(tr) == s_tick
+    # wire context round-trips through the validator
+    ctx = xtrace.wire_context(tr, s_tick)
+    assert xtrace.continue_from(ctx) == (tr, s_tick)
+    # garbage degrades to untraced, never raises
+    for bad in (None, 7, [], {}, {"t": 1, "s": "x"},
+                {"t": "a" * 65, "s": "b"}, {"t": "", "s": "b"}):
+        assert xtrace.continue_from(bad) == (None, None)
+
+
+def test_bind_ops_first_wins_and_traces_of():
+    obs.configure(enabled=True)
+    t1, t2 = xtrace.new_trace(), xtrace.new_trace()
+    ops = [(1, "s", 0), (2, "s", 0)]
+    xtrace.bind_ops(t1, ops)
+    xtrace.bind_ops(t2, ops)  # replay re-bind: original trace kept
+    assert xtrace.trace_of(ops[0]) == t1
+    assert xtrace.trace_of([1, "s", 0]) == t1  # list form joins too
+    xtrace.bind_ops(t2, [(3, "s", 0)])
+    assert xtrace.traces_of(ops + [(3, "s", 0)]) == [t1, t2]
+
+
+def test_obs_reset_delegates_to_lag_and_xtrace():
+    """Satellite: one obs.reset() reaches every tracer — the xtrace
+    op/span registries and the lag document registry both drop."""
+    obs.configure(enabled=True)
+    tr = xtrace.new_trace()
+    xtrace.hop("mint", tr, parent="")
+    xtrace.bind_ops(tr, [(1, "s", 0)])
+    lag.op_created("doc", [(1, "s", 0)])
+    assert lag.pending_ops() == 1
+    assert xtrace.trace_of((1, "s", 0)) == tr
+    obs.reset()
+    obs.configure(enabled=True)
+    assert xtrace.trace_of((1, "s", 0)) is None
+    assert xtrace.last_span(tr) is None
+    assert lag.pending_ops() == 0
+
+
+# -------------------------------------------------------- end to end
+
+
+def test_wire_journey_end_to_end(tmp_path):
+    """A queued batch's trace crosses the wire: mint/send client-side,
+    recv/admit/journal server-side (ctx + op-id binding), tick/wave
+    after the serve tick — one journey, zero orphans."""
+    obs.configure(enabled=True)
+    svc, uuid = _service(tmp_path)
+    srv = ReplicationServer(svc).start()
+    try:
+        cl = NetClient("127.0.0.1", srv.port, [uuid], client_id="jny",
+                       read_timeout_s=2.0)
+        site = new_site_id()
+        ops = _mint(site, 4)
+        assert cl.queue_ops(uuid, site, ops)
+        st = cl.pump()
+        assert st["acked_ops"] == 4, st
+        svc.tick()
+        cl.close()
+    finally:
+        srv.stop()
+    fold = JourneyFold(retain_all=True)
+    fold.feed_many(obs.events())
+    # the client minted exactly one wire trace for the batch
+    mints = [e for e in _events("xtrace.hop")
+             if e["fields"]["hop"] == "mint"
+             and e["fields"].get("client") == "jny"]
+    assert len(mints) == 1
+    tr = mints[0]["fields"]["trace"]
+    j = fold.journey(tr)
+    assert j is not None
+    names = _hop_names(j)
+    for need in ("mint", "send", "recv", "admit", "journal", "tick",
+                 "wave"):
+        assert need in names, (need, names)
+    assert names.index("mint") < names.index("send") \
+        < names.index("recv") < names.index("admit")
+    assert j["orphans"] == 0
+    # the server bound the batch's op ids from the wire ctx
+    assert xtrace.trace_of(tuple(ops[0][0])) == tr
+    # one hello clock sample rode the connect
+    clocks = _events("xtrace.clock")
+    assert clocks and clocks[0]["fields"]["via"] == "hello"
+
+
+def test_old_client_new_server_ctxless_frames(tmp_path):
+    """Backward compat: a ctx-less (pre-PR / obs-off) client against
+    an obs-ON server admits normally — ctx is an optional key, and no
+    recv hop is fabricated for an untraced frame."""
+    obs.configure(enabled=True)
+    svc, uuid = _service(tmp_path)
+    srv = ReplicationServer(svc).start()
+    try:
+        fs = transport.dial("127.0.0.1", srv.port,
+                            connect_timeout_s=2.0, read_timeout_s=2.0)
+        transport.send_msg(fs, {"op": "hello", "client": "old",
+                                "uuids": [uuid]})
+        welcome = transport.recv_msg(fs, timeout_s=2.0)
+        # the new server stamps its welcome (obs on); an old client
+        # simply ignores the unknown keys
+        assert welcome["op"] == "welcome"
+        assert isinstance(welcome.get("ts_us"), int)
+        site = new_site_id()
+        items = serde.encode_node_items(
+            {nid: (parent, val) for nid, parent, val
+             in _mint(site, 3)})
+        transport.send_msg(fs, {"op": "delta", "seq": 1, "uuid": uuid,
+                                "site": site, "nodes": items,
+                                "crc": sync.payload_checksum(items)})
+        ack = transport.recv_msg(fs, timeout_s=2.0)
+        assert ack["op"] == "ack" and ack["admitted"] == 3, ack
+        fs.close()
+    finally:
+        srv.stop()
+    # untraced frame: admission/journal hops exist only for traces —
+    # none minted here, so no recv hop at all
+    assert [e for e in _events("xtrace.hop")
+            if e["fields"]["hop"] == "recv"] == []
+
+
+def test_new_client_old_server_no_stamp_no_ctx_choke(tmp_path):
+    """Forward compat: an obs-ON client against an OLD server (no
+    reply stamps, ctx silently ignored) replicates normally and
+    records zero clock samples — clock_sample degrades to None on a
+    stampless welcome."""
+    obs.configure(enabled=True)
+    uuid = "tenant-old"
+    got = {}
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+
+    def old_server():
+        conn, _peer = lsock.accept()
+        fs = transport.FrameStream(conn, site="net.server")
+        hello = transport.recv_msg(fs, timeout_s=5.0)
+        got["hello"] = hello
+        # the OLD protocol: welcome carries wm/unknown only — no
+        # ts_us/pid stamp
+        transport.send_msg(fs, {"op": "welcome", "wm": {uuid: {}},
+                                "unknown": []})
+        frame = transport.recv_msg(fs, timeout_s=5.0)
+        got["delta"] = frame
+        transport.send_msg(fs, {"op": "ack",
+                                "seq": frame.get("seq"),
+                                "admitted": len(frame.get("nodes"))})
+        fs.close()
+
+    t = threading.Thread(target=old_server, daemon=True)
+    t.start()
+    try:
+        cl = NetClient("127.0.0.1", port, [uuid], client_id="new",
+                       read_timeout_s=2.0)
+        site = new_site_id()
+        assert cl.queue_ops(uuid, site, _mint(site, 2))
+        st = cl.pump()
+        assert st["connected"] and st["acked_ops"] == 2, st
+        cl.close()
+    finally:
+        t.join(timeout=5)
+        lsock.close()
+    # the new client DID attach ctx (obs on) — the old server ignored
+    # the unknown key without choking
+    assert isinstance(got["delta"].get("ctx"), list)
+    # and the stampless welcome produced no clock sample
+    assert _events("xtrace.clock") == []
+    assert [e for e in _events("xtrace.hop")
+            if e["fields"]["hop"] == "send"] != []
+
+
+def test_journey_across_restore_relinks_journal_traces(tmp_path):
+    """A crash between admission and tick must not orphan the
+    journey: the journal row carries the trace ids, the restore
+    replay re-links them (replay hop + re-bound op ids), and the
+    post-restore tick/wave hops continue the SAME trace."""
+    obs.configure(enabled=True)
+    svc, uuid = _service(tmp_path)
+    svc.checkpoint()
+    left, _right = svc.residency.get(uuid).pairs[0]
+    l2 = left.conj("x1")
+    items = serde.encode_node_items(
+        sync.delta_nodes(l2, sync.version_vector(left)))
+    nid = tuple(serde.decode_node_items(items).keys())[0]
+    tr = xtrace.trace_of(nid)
+    assert tr, "the mutation funnel binds the op at creation time"
+    adm = svc.queue.offer(uuid, l2.ct.site_id, items)
+    assert adm.admitted
+    # offer CONTINUES the funnel journey — a second mint here would
+    # split one causal chain into two half-journeys
+    assert not [e for e in _events("xtrace.hop")
+                if e["fields"]["hop"] == "mint"
+                and e["fields"].get("source") == "offer"]
+    # the journal row carries the trace id — the cross-process link
+    recs = [json.loads(ln) for ln
+            in open(str(tmp_path / "wal.jsonl"))
+            if ln.strip() and "seq" in ln]
+    assert any(r.get("trace") == [tr] for r in recs), recs
+    chaos.configure(plan={"seed": 7, "faults": [
+        {"family": "crash", "site": "serve.tick", "at": [1]}]})
+    with pytest.raises(ServiceCrashed):
+        svc.tick()
+    del svc
+    chaos.reset()
+    svc2 = SyncService.restore(str(tmp_path / "ckpt"))
+    svc2.tick()
+    fold = JourneyFold(retain_all=True)
+    fold.feed_many(obs.events())
+    j = fold.journey(tr)
+    assert j is not None
+    names = _hop_names(j)
+    for need in ("mint", "admit", "journal", "replay", "wave"):
+        assert need in names, (need, names)
+    assert names.index("journal") < names.index("replay") \
+        < names.index("wave")
+    assert j["orphans"] == 0
+    # replay re-bound the ids: the restored process can still join
+    # op -> trace for lag drill-down
+    assert xtrace.trace_of(nid) == tr
+
+
+# -------------------------------------------- skew-corrected ordering
+
+
+def _rec(pid, ts_us, name, **fields):
+    return {"ev": "event", "name": name, "ts_us": ts_us, "pid": pid,
+            "tid": 1, "parent": "", "platform": "cpu",
+            "fields": fields}
+
+
+def test_journey_corrects_cross_host_clock_skew(tmp_path):
+    """Synthetic two-process streams with the client clock 5 s AHEAD:
+    raw timestamps order the server hops before the mint; the fold's
+    median offset correction restores causal order and positive
+    per-hop deltas."""
+    tr = "ab" * 8
+    client, server = 111, 222
+    # client wall clock = server + 5 s; hello measured it:
+    # offset_us = server_ts - midpoint(local) = -5_000_000
+    stream_client = [
+        _rec(client, 10_000_000, "xtrace.clock", remote_pid=server,
+             offset_us=-5_000_000.0, rtt_us=800, via="hello"),
+        _rec(client, 10_000_000, "xtrace.hop", trace=tr, span="c.1",
+             parent="", hop="mint"),
+        _rec(client, 10_001_000, "xtrace.hop", trace=tr, span="c.2",
+             parent="c.1", hop="send"),
+    ]
+    stream_server = [
+        _rec(server, 5_002_000, "xtrace.hop", trace=tr, span="s.1",
+             parent="c.2", hop="recv"),
+        _rec(server, 5_003_000, "xtrace.hop", trace=tr, span="s.2",
+             parent="s.1", hop="admit"),
+        _rec(server, 5_004_500, "xtrace.hop", trace=tr, span="s.3",
+             parent="s.2", hop="converged"),
+    ]
+    fold = JourneyFold(retain_all=True)
+    fold.feed_many(stream_client + stream_server)
+    offsets, ref = fold.offsets()
+    assert ref == server
+    assert offsets[client] == -5_000_000.0 and offsets[server] == 0.0
+    j = fold.journey(tr)
+    assert _hop_names(j) == ["mint", "send", "recv", "admit",
+                             "converged"]
+    assert all(h["dt_ms"] >= 0 for h in j["hops"])
+    assert j["orphans"] == 0 and j["complete"]
+    # corrected total: mint at corrected 5_000_000 -> converged at
+    # 5_004_500 = 4.5 ms (raw timestamps would say "minus 4995.5 ms")
+    assert j["total_ms"] == pytest.approx(4.5, abs=0.01)
+    assert j["edges"]["send→recv"] == pytest.approx(1.0, abs=0.01)
+    # a hop whose parent span never appears is an ORPHAN — lost
+    # evidence is counted, not silently absorbed
+    fold.feed(_rec(server, 5_005_000, "xtrace.hop", trace=tr,
+                   span="s.9", parent="GONE", hop="shed"))
+    j2 = fold.journey(tr)
+    assert j2["orphans"] == 1
+    # ...and the CLI path over the same streams agrees
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    pa.write_text("".join(json.dumps(r) + "\n" for r in stream_client))
+    pb.write_text("".join(json.dumps(r) + "\n" for r in stream_server))
+    res = subprocess.run(
+        [sys.executable, "-m", "cause_tpu.obs", "journey", tr,
+         str(pa), str(pb)],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert res.returncode == 0, res.stderr
+    assert "5 hop(s) across 2 process(es)" in res.stdout
+    assert "send→recv 1ms" in res.stdout.replace(".0ms", "ms") \
+        or "send→recv" in res.stdout
+    rep = journey_report(stream_client + stream_server)
+    assert rep["complete"] == 1 and rep["orphan_hops"] == 0
+    assert rep["clock"]["ref_pid"] == server
+
+
+# --------------------------------------------------- lag drill-down
+
+
+def test_lag_worst_offender_carries_journey_trace_id():
+    """Satellite: the lag tracer's worst-offender rows print the
+    exact trace id the journey CLI accepts — the drill-down chain
+    `obs lag` -> worst_trace -> `obs journey <id>` closes."""
+    obs.configure(enabled=True)
+    tr = xtrace.new_trace()
+    xtrace.hop("mint", tr, parent="")
+    op = (1, "siteX", 0)
+    xtrace.bind_ops(tr, [op])
+    lag.op_created("doc", [op])
+    time.sleep(0.002)
+    lag.ops_applied("doc", [op], replica="rep-1")
+    reps = [e for e in _events("lag.replica")]
+    assert reps and reps[-1]["fields"]["worst_trace"] == tr
+    red = lag.LagReducer()
+    for e in obs.events():
+        red.feed(e)
+    rows = red.report()["replicas"]
+    assert rows and rows[0]["worst_trace"] == tr
+    assert tr in lag.render(red.report())
+    # sampled op.lag events carry the same id
+    lag.wave_observed("doc", agreed=True)
+    assert any(e["fields"].get("trace") == tr
+               for e in _events("op.lag"))
+    # and the journey fold resolves it
+    fold = JourneyFold(retain_all=True)
+    fold.feed_many(obs.events())
+    j = fold.journey(tr)
+    assert j is not None and "converged" in _hop_names(j)
+
+
+def test_live_fold_journey_section_and_prometheus():
+    """The live dashboard's journey section folds the same hop
+    stream: counts, p99 and the worst-exemplar drill-down id."""
+    from cause_tpu.obs.live import LiveFold
+    from cause_tpu.obs.watch import prometheus_text, render
+
+    tr = "cd" * 8
+    stream = [
+        _rec(7, 1_000_000, "xtrace.hop", trace=tr, span="a.1",
+             parent="", hop="mint"),
+        _rec(7, 1_250_000, "xtrace.hop", trace=tr, span="a.2",
+             parent="a.1", hop="converged"),
+    ]
+    lf = LiveFold()
+    for r in stream:
+        lf.feed(r)
+    snap = lf.snapshot()
+    jy = snap["journey"]
+    assert jy["active"] and jy["traces"] == 1 and jy["complete"] == 1
+    # 250 ms > the 100 ms SLO: retained as a tail exemplar
+    assert jy["worst_trace"] == tr
+    assert jy["total_p99_ms"] == pytest.approx(250.0, rel=0.5)
+    out = render(snap, alerts=[], paths=["x"])
+    assert "journeys:" in out and tr in out
+    prom = prometheus_text(snap)
+    assert "cause_tpu_live_journey_traces_total 1" in prom
+    assert "cause_tpu_live_journey_complete_total 1" in prom
+
+
+def test_live_fold_inside_slo_journeys_fold_without_exemplar():
+    """Tail-based retention: an inside-SLO, orphan-free journey folds
+    into the histograms but keeps no hop detail."""
+    from cause_tpu.obs.live import LiveFold
+
+    tr = "ef" * 8
+    lf = LiveFold()
+    lf.feed(_rec(7, 1_000_000, "xtrace.hop", trace=tr, span="a.1",
+                 parent="", hop="mint"))
+    lf.feed(_rec(7, 1_002_000, "xtrace.hop", trace=tr, span="a.2",
+                 parent="a.1", hop="converged"))
+    jy = lf.snapshot()["journey"]
+    assert jy["complete"] == 1 and jy["worst_trace"] is None
+    assert lf.journeys.journey(tr) is None  # detail dropped
+    assert jy["total_p50_ms"] == pytest.approx(2.0, rel=0.5)
