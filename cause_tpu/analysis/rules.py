@@ -77,7 +77,7 @@ REGISTRY: dict = {}
 # Bumped whenever rule logic or the rule set changes; the incremental
 # cache (core.cached_run) keys on it so a rule-set change invalidates
 # every cached verdict even when no analyzed file changed.
-RULESET_VERSION = 4  # PR 19: XTR001 gates the cross-process tracer
+RULESET_VERSION = 5  # PR 20: SHP001 gates the telemetry-ship layer
 
 
 def rule(rule_id: str, help_text: str):
@@ -804,6 +804,26 @@ _GUARD_RULES = (
         "the durable-storage layer fsyncs descriptors, rotates and "
         "retires segment files and re-checks CRCs over whole "
         "directories"),
+    # PR 20: the telemetry-shipping layer — the exporter spawns a
+    # pump thread and dials sockets, the collector binds listeners
+    # and appends to a WAL; both are obs-off no-ops ONLY through
+    # attach_exporter's subscribe gate, so reaching the classes
+    # directly from jit-reachable code must carry the guard
+    _GuardSpec(
+        "SHP001",
+        "telemetry-shipping API reached from jit-reachable code "
+        "without an obs.enabled() guard (the ship layer spawns pump "
+        "threads, dials collector sockets and buffers records; the "
+        "collector binds listeners and appends WAL segments — host "
+        "plumbing that must never sit on a traced path)",
+        frozenset({"ShipExporter", "CollectorServer",
+                   "attach_exporter"}),
+        frozenset({"ship", "_ship", "collector", "_collector"}),
+        lambda module: False,
+        "an obs.enabled()",
+        "the shipping layer spawns threads, dials sockets and "
+        "persists segments when obs is on",
+        prefix="ship"),
 )
 
 

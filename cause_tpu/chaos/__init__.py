@@ -39,7 +39,17 @@ in the obs stream:
   (the WAL rotates to a fresh segment with evidence), and ``rename``
   fails the atomic manifest/GC rename (the previous manifest must
   stay intact) — all caught by ``cause_tpu/serve/wal.py`` and the
-  checkpoint path, scrubbed by ``python -m cause_tpu.serve scrub``.
+  checkpoint path, scrubbed by ``python -m cause_tpu.serve scrub``;
+- **ship** faults (PR 20) disrupt the TELEMETRY link only — the
+  obs-shipping plane between a :class:`~cause_tpu.obs.ship.ShipExporter`
+  and the collector: ``partition`` refuses exporter dials, ``drop``
+  silently discards an outbound obs frame, ``dup`` sends one obs
+  frame twice (same (origin, seq) — the collector's watermark dedup
+  must absorb it), ``reorder`` holds a frame back one send so the
+  next frame overtakes it — all absorbed by the exporter's
+  reconnect/watermark-resume machinery and the collector's per-origin
+  dedup. The data plane NEVER sees these: ship faults prove the soak
+  stays bit-identical while telemetry degrades.
 
 Determinism: every fault spec keeps its own per-site invocation
 counter and its own ``random.Random((plan seed, spec index))`` stream,
@@ -90,14 +100,20 @@ __all__ = [
     "disk_enospc",
     "disk_fsync_fail",
     "disk_rename_fail",
+    "ship_partition",
+    "ship_drop",
+    "ship_dup",
+    "ship_reorder",
     "injected",
     "chaos_report",
 ]
 
-FAMILIES = ("payload", "dispatch", "crash", "stall", "net", "disk")
+FAMILIES = ("payload", "dispatch", "crash", "stall", "net", "disk",
+            "ship")
 PAYLOAD_MODES = ("corrupt", "truncate", "duplicate", "reorder", "drop")
 NET_MODES = ("partition", "reset", "latency", "blackhole", "dup")
 DISK_MODES = ("torn", "bitrot", "enospc", "fsync", "rename")
+SHIP_MODES = ("partition", "drop", "dup", "reorder")
 # the value planted by payload corruption: tests and the chaos soak
 # gate grep converged documents for it — an admitted corruption is a
 # validation hole, not a flake
@@ -146,6 +162,10 @@ class _Fault:
             self.mode = self.mode or "torn"
             if self.mode not in DISK_MODES:
                 raise ValueError(f"unknown disk mode: {self.mode!r}")
+        elif self.family == "ship":
+            self.mode = self.mode or "drop"
+            if self.mode not in SHIP_MODES:
+                raise ValueError(f"unknown ship mode: {self.mode!r}")
         self.at = frozenset(int(x) for x in (spec.get("at") or ()))
         self.prob = float(spec.get("prob") or 0.0)
         self.times = int(spec.get("times") or 0)
@@ -547,6 +567,68 @@ def disk_rename_fail(site: str) -> bool:
     manifest/GC rename (the caller must keep the previous manifest
     intact and surface the failure loudly)."""
     f = _decide(f"{site}.rename", "disk", mode="rename")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+# ------------------------------------------------------ ship (PR 20)
+#
+# Telemetry-link fault hooks for the obs shipping plane. Mode-filtered
+# like the net/disk families (a ``drop`` spec never advances at the
+# dup hook and vice versa), so one plan schedules independent
+# partition/drop/dup/reorder streams against the telemetry link with
+# per-spec determinism. Site convention mirrors the net family: the
+# exporter calls the dial-side hook at ``<site>.connect`` and the
+# frame-send hooks at ``<site>.send``, so a spec's ``site`` of
+# ``obs.ship`` matches both via the prefix rule. These hooks fire
+# ONLY inside the shipping layer — the data-plane transport never
+# calls them, which is exactly what lets a ship-chaos soak gate on
+# bit-identical data-plane output while the telemetry plane burns.
+
+
+def ship_partition(site: str) -> bool:
+    """Whether a ``partition``-mode ship fault refuses this exporter
+    dial (the exporter's seeded backoff ladder owns the retry; records
+    keep accumulating in the bounded buffer, oldest dropped with
+    evidence). One invocation per dial."""
+    f = _decide(f"{site}.connect", "ship", mode="partition")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def ship_drop(site: str) -> bool:
+    """Whether a ``drop``-mode ship fault silently discards this
+    outbound obs frame (the send "succeeds" locally, nothing crosses
+    the wire — the collector's watermark gap plus the exporter's
+    unacked resend window are the detectors)."""
+    f = _decide(f"{site}.send", "ship", mode="drop")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def ship_dup(site: str) -> bool:
+    """Whether a ``dup``-mode ship fault sends this obs frame twice
+    (same (origin, seq) on the wire — the collector's per-origin
+    watermark dedup must absorb it without a duplicate record)."""
+    f = _decide(f"{site}.send", "ship", mode="dup")
+    if f is None:
+        return False
+    _record(f, site)
+    return True
+
+
+def ship_reorder(site: str) -> bool:
+    """Whether a ``reorder``-mode ship fault holds this obs frame back
+    one send, letting the next frame overtake it (the collector sees
+    seqs arrive out of order and must either buffer or refuse-and-let-
+    resume repair — never persist out of watermark order)."""
+    f = _decide(f"{site}.send", "ship", mode="reorder")
     if f is None:
         return False
     _record(f, site)

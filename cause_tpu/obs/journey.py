@@ -490,6 +490,7 @@ def main(argv=None) -> int:
     import argparse
     import json
     import os
+    import re
     import sys
 
     from .perfetto import load_streams
@@ -506,6 +507,12 @@ def main(argv=None) -> int:
                          "op.lag records); omit with --worst")
     ap.add_argument("jsonl", nargs="*",
                     help="obs event file(s) (JSON lines)")
+    ap.add_argument("--file", action="append", default=None,
+                    metavar="PATH", dest="files",
+                    help="obs event file (repeatable; unambiguous "
+                         "alternative to the positional file list — "
+                         "a positional that is both 16-hex and an "
+                         "existing path always means the trace id)")
     ap.add_argument("--worst", type=int, default=None, metavar="N",
                     help="show the N worst journeys by total latency "
                          "instead of one trace id")
@@ -515,11 +522,16 @@ def main(argv=None) -> int:
                     help="emit JSON instead of text")
     a = ap.parse_args(argv)
 
-    files = list(a.jsonl)
+    files = list(a.files or []) + list(a.jsonl)
     trace = a.trace
     # `journey --worst 5 a.jsonl b.jsonl`: the first file lands in the
-    # optional trace slot — a trace id is never an existing path
-    if trace is not None and os.path.exists(trace):
+    # optional trace slot. A bare 16-hex token is ALWAYS a trace id —
+    # before PR 20 an unlucky file named like one (`ls > deadbeef...`)
+    # silently won the os.path.exists tiebreak and was read as a
+    # stream; now only a non-id-shaped existing path demotes to the
+    # file list (--file skips the heuristic entirely).
+    if trace is not None and not re.fullmatch(r"[0-9a-f]{16}", trace) \
+            and os.path.exists(trace):
         files.insert(0, trace)
         trace = None
     if not files:

@@ -438,6 +438,65 @@ def check(path: Optional[str] = None,
     }
 
 
+def pending(path: Optional[str] = None,
+            rows: Optional[List[dict]] = None) -> dict:
+    """The chip-pending claim matrix (PR 20): every (kind, smoke,
+    kernel, config) experiment that has committed rows but NO
+    un-quarantined ``platform == "tpu"`` row — i.e. every claim the
+    ledger is still owed real-chip evidence for. ROADMAP item 1's
+    tunnel-window checklist is generated from this instead of
+    hand-maintained prose: the next TPU window runs exactly these
+    partitions. Quarantined rows never claim (a fallback-poisoned
+    tpu row is not evidence)."""
+    rows = load(path) if rows is None else rows
+    groups: Dict[Tuple, Dict] = {}
+    for r in rows:
+        if r.get("quarantined"):
+            continue
+        kind, platform, smoke, kernel, config = _partition_key(r)
+        g = groups.setdefault((kind, smoke, kernel, config), {
+            "platforms": {}, "latest_source": None})
+        g["platforms"][platform] = \
+            g["platforms"].get(platform, 0) + 1
+        g["latest_source"] = r.get("source") or g["latest_source"]
+    pend = []
+    claimed = 0
+    for (kind, smoke, kernel, config), g in sorted(
+            groups.items(), key=lambda kv: [str(x) for x in kv[0]]):
+        has_tpu = any(str(p) == "tpu" for p in g["platforms"])
+        if has_tpu:
+            claimed += 1
+            continue
+        pend.append({
+            "kind": kind, "smoke": smoke, "kernel": kernel,
+            "config": config,
+            "platforms": dict(sorted(g["platforms"].items(),
+                                     key=lambda kv: str(kv[0]))),
+            "latest_source": g["latest_source"],
+        })
+    return {"partitions": len(groups), "claimed": claimed,
+            "pending": pend}
+
+
+def render_pending(matrix: dict) -> str:
+    lines = [f"chip-pending claim matrix: {len(matrix['pending'])} "
+             f"pending / {matrix['partitions']} partition(s) "
+             f"({matrix['claimed']} tpu-claimed)"]
+    if not matrix["pending"]:
+        lines.append("  (every partition has a tpu row — nothing "
+                     "owed)")
+        return "\n".join(lines)
+    lines.append(f"  {'kind':<9s} {'size':<6s} {'kernel':<22s} "
+                 f"{'config':<22s} evidence so far")
+    for p in matrix["pending"]:
+        ev = ", ".join(f"{plat}:{n}"
+                       for plat, n in p["platforms"].items())
+        lines.append(
+            f"  {p['kind']:<9s} {'smoke' if p['smoke'] else 'full':<6s} "
+            f"{p['kernel']:<22s} {p['config']:<22s} {ev}")
+    return "\n".join(lines)
+
+
 # ----------------------------------------------------------------- CLI
 
 
@@ -471,6 +530,13 @@ def main(argv=None) -> int:
                          "partitioned, quarantined only on fallback)")
     ap.add_argument("--check", action="store_true",
                     help="regression verdict; exit 1 on any regression")
+    ap.add_argument("--pending", action="store_true",
+                    help="render the chip-pending claim matrix: every "
+                         "kind/config partition lacking an "
+                         "un-quarantined tpu row (the next TPU "
+                         "window's checklist)")
+    ap.add_argument("--json", action="store_true",
+                    help="with --pending: emit the matrix as JSON")
     a = ap.parse_args(argv)
     path = a.ledger or None
 
@@ -485,6 +551,11 @@ def main(argv=None) -> int:
                      path=path, kind=a.kind)
         print(f"ledger: ingested {row['platform']} row from "
               f"{a.ingest}", file=sys.stderr)
+        did_something = True
+    if a.pending:
+        matrix = pending(path)
+        print(json.dumps(matrix, indent=1) if a.json
+              else render_pending(matrix))
         did_something = True
     if a.check:
         verdict = check(path)

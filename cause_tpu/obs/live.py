@@ -100,7 +100,8 @@ class LiveFold:
                  "headroom_min", "headroom_last", "heartbeat",
                  "serve_gauges", "_shed_ts", "shed_total",
                  "serve_ticks", "net_gauges", "net_counts",
-                 "_reconnect_ts", "disk_faults", "journal_torn")
+                 "_reconnect_ts", "disk_faults", "journal_torn",
+                 "obs_gauges")
 
     def __init__(self):
         self.fleet = FleetReducer()
@@ -148,6 +149,11 @@ class LiveFold:
         # counts in its fields)
         self.disk_faults = 0
         self.journal_torn = 0
+        # PR 20, the telemetry plane's own health: ``obs.dropped.*``
+        # gauges (per-subscriber drop counters — a saturated bounded
+        # queue used to drop silently into a field nobody watched)
+        # and whatever else the shipping layer gauges under ``obs.``
+        self.obs_gauges: Dict[str, float] = {}
 
     def feed(self, e: dict) -> None:
         self.fleet.feed(e)
@@ -225,6 +231,10 @@ class LiveFold:
                 v = e.get("value")
                 if isinstance(v, (int, float)):
                     self.net_gauges[name[len("net."):]] = v
+            elif name.startswith("obs."):
+                v = e.get("value")
+                if isinstance(v, (int, float)):
+                    self.obs_gauges[name[len("obs."):]] = v
 
     def feed_many(self, events: Iterable[dict]) -> None:
         for e in events:
@@ -268,6 +278,20 @@ class LiveFold:
         cutoff = now_us - int(window_s * 1e6)
         n = sum(1 for t in self._reconnect_ts if t >= cutoff)
         return round(n * 60.0 / window_s, 4)
+
+    def _obs_dropped(self) -> Optional[float]:
+        """Total subscriber-queue drops across every bounded
+        subscriber: the gauges are per-source
+        (``obs.dropped.<source>``) because one healthy subscriber
+        would mask another's saturation under a single shared name.
+        The bare un-suffixed spelling still counts. None (never a
+        fake 0) when nothing gauged drops yet — the ``obs_dropped>0``
+        rule must stay inert on streams without the gauge."""
+        vals = [v for k, v in self.obs_gauges.items()
+                if k == "dropped" or k.startswith("dropped.")]
+        if not vals:
+            return None
+        return sum(vals)
 
     def _net_outbound(self) -> Optional[float]:
         """Total queued outbound ops across every client: the gauges
@@ -363,6 +387,9 @@ class LiveFold:
                 "outbound_depth": self._net_outbound(),
                 "connections": self.net_gauges.get("connections"),
             },
+            "obs": {
+                "dropped": self._obs_dropped(),
+            },
             "journey": self.journeys.summary(),
             "ages_s": self.ages_s(now),
         }
@@ -431,6 +458,9 @@ RULE_ALIASES = {
     "journal_torn": "serve.journal_torn",
     "wal_bytes": "serve.wal_bytes",
     "wal_segments": "serve.wal_segments",
+    # PR 20: the telemetry plane's own drop evidence — total bounded-
+    # subscriber drops gauged under obs.dropped[.source]
+    "obs_dropped": "obs.dropped",
 }
 
 _OPS: Dict[str, Callable[[float, float], bool]] = {
@@ -600,7 +630,15 @@ DEFAULT_RULE_SPECS = ("burn>2", "absence:wave.digest:120",
                       # section, whose counters stay 0 with no serve
                       # records, and Rule._condition's activity gate
                       # keeps them silent there
-                      "disk_faults>0", "journal_torn>0")
+                      "disk_faults>0", "journal_torn>0",
+                      # PR 20, the telemetry plane's own health: ANY
+                      # bounded-subscriber drop is operator news — the
+                      # telemetry is best-effort by contract, but a
+                      # saturated queue means the dashboard is now
+                      # lying by omission and the operator must know
+                      # how much. Inert on streams without the gauge
+                      # (a missing value never fires a threshold rule)
+                      "obs_dropped>0")
 
 
 def default_rules() -> List[Rule]:
@@ -786,17 +824,31 @@ class LiveAttachment:
     one), evaluates the rules and optionally emits a snapshot.
     Detach with :meth:`close`."""
 
-    __slots__ = ("sub", "monitor")
+    __slots__ = ("sub", "monitor", "_dropped_gauged")
 
     def __init__(self, sub, monitor: LiveMonitor):
         self.sub = sub
         self.monitor = monitor
+        self._dropped_gauged = 0
 
     def poll(self, emit_snapshot: bool = False,
              evaluate: bool = True) -> dict:
         """Drain + fold + (evaluate, snapshot). Returns the fresh
         snapshot dict (its ``alerts_total`` includes anything fired
         by this call)."""
+        # PR 20: a saturated bounded queue used to drop silently into
+        # a field nobody watched — gauge it BEFORE draining so the
+        # gauge record rides this very drain and the ``obs_dropped>0``
+        # default rule fires on the same poll that discovered the
+        # saturation. (Gauging into a still-full queue costs one more
+        # drop; the gauge intentionally trails by that record — the
+        # rule only needs "any", and the count converges once the
+        # queue drains.)
+        if self.sub.dropped != self._dropped_gauged and core.enabled():
+            self._dropped_gauged = self.sub.dropped
+            core.gauge(
+                f"obs.dropped.{self.monitor.source}").set(
+                    self.sub.dropped)
         self.monitor.feed(self.sub.drain())
         snap_regs = core.counters_snapshot()
         if snap_regs["counters"] or snap_regs["gauges"]:

@@ -15,6 +15,16 @@ a CI log (where ``--once`` prints the block exactly once).
     python -m cause_tpu.obs watch events.jsonl --rules "burn>2" \\
         --rules "absence:run.heartbeat:600"
     python -m cause_tpu.obs watch events.jsonl --serve-port 9464
+    python -m cause_tpu.obs watch --collector host:9419       # fleet
+
+``--collector HOST:PORT`` (PR 20) reads the fleet-wide fold from a
+running :class:`~cause_tpu.obs.collector.CollectorServer` over its
+socket feed instead of tailing local files — every host's serve/net/
+lag/journey axes appear WHILE the fleet runs, no file merging. The
+snapshot arrives with per-origin (host, pid) rows; the Prometheus
+endpoint emits them as labeled serve/net series so multi-origin
+scrapes never clobber each other (label cardinality is bounded by the
+collector's origin LRU, not by traffic).
 
 ``--serve-port`` additionally serves the snapshot as Prometheus text
 (``/metrics``, stdlib http.server — no client dependency) and as JSON
@@ -182,6 +192,19 @@ def render(snap: dict, alerts: List[dict], paths: List[str],
             lines.append(
                 f"    worst: {_g(jy.get('worst_total_ms'))} ms — "
                 f"`obs journey {jy['worst_trace']}`")
+    shp = snap.get("ship") or {}
+    if shp.get("active"):
+        lines.append(
+            f"  ship: {shp.get('origins', 0)} origin(s), "
+            f"{shp.get('accepted', 0)} record(s) accepted, "
+            f"{shp.get('dup_records', 0)} dup-skipped, "
+            f"{shp.get('missed', 0)} missed (evidenced), "
+            f"{shp.get('unexplained_gaps', 0)} unexplained gap(s)")
+        for o in (snap.get("origins") or [])[:8]:
+            lines.append(
+                f"    {o['host']}:{o['pid']}: wm {o['watermark']}, "
+                f"{o['accepted']} accepted, {o['missed']} missed, "
+                f"last {_g(o.get('age_s'))} s ago")
     hb = snap.get("heartbeat")
     if hb:
         hb_age = ages.get("run.heartbeat")
@@ -279,10 +302,24 @@ _PROM_METRICS = (
 )
 
 
+def _prom_name(raw: str) -> str:
+    return "".join(c if c.isalnum() or c == "_" else "_" for c in raw)
+
+
+def _prom_label(raw) -> str:
+    return str(raw).replace("\\", "\\\\").replace('"', '\\"')
+
+
 def prometheus_text(snap: dict) -> str:
     """The snapshot as Prometheus exposition text (version 0.0.4):
     one line per known metric, Nones skipped — a scraper sees only
-    what the stream actually measured."""
+    what the stream actually measured. A collector snapshot's
+    per-origin rows additionally emit every serve/net gauge as a
+    (host, pid)-labeled series — without the labels a multi-origin
+    scrape is last-writer-wins per metric name, i.e. one arbitrary
+    host's queue depth wearing the fleet's name. Series cardinality
+    is bounded by the collector's origin LRU: an evicted origin's
+    row simply stops being exported."""
     from .live import snapshot_path
 
     lines = []
@@ -292,6 +329,20 @@ def prometheus_text(snap: dict) -> str:
             continue
         lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {v:g}")
+    typed = set()
+    for o in snap.get("origins") or []:
+        labels = (f'{{host="{_prom_label(o.get("host"))}"'
+                  f',pid="{_prom_label(o.get("pid"))}"}}')
+        for sect in ("serve", "net"):
+            for k, v in sorted((o.get(sect) or {}).items()):
+                if not isinstance(v, (int, float)) \
+                        or isinstance(v, bool):
+                    continue
+                name = f"cause_tpu_origin_{sect}_{_prom_name(k)}"
+                if name not in typed:
+                    typed.add(name)
+                    lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name}{labels} {v:g}")
     return "\n".join(lines) + "\n"
 
 
@@ -335,6 +386,62 @@ def serve_metrics(port: int, get_snapshot: Callable[[], dict]):
     return server, server.server_address[1]
 
 
+# ---------------------------------------------------- collector feed
+
+
+class _CollectorFeed:
+    """One persistent connection to a CollectorServer: ``snap()``
+    requests the fleet-wide fold snapshot ({"op": "snap"}) and
+    returns it, reconnecting lazily across ticks — a collector
+    restart costs one missed frame, not a dead dashboard. The watch
+    side is a pure reader: no hello, no origin row, no watermark."""
+
+    def __init__(self, host: str, port: int,
+                 connect_timeout_s: float = 2.0,
+                 read_timeout_s: float = 5.0):
+        self.host = host
+        self.port = int(port)
+        self.connect_timeout_s = float(connect_timeout_s)
+        self.read_timeout_s = float(read_timeout_s)
+        self.fs = None
+        self.last_error: Optional[str] = None
+
+    def snap(self) -> Optional[dict]:
+        import socket
+
+        from .. import sync as _sync
+        from ..collections import shared as _s
+        from ..net import transport as _transport
+
+        try:
+            if self.fs is None:
+                sock = socket.create_connection(
+                    (self.host, self.port),
+                    timeout=self.connect_timeout_s)
+                sock.settimeout(self.read_timeout_s)
+                self.fs = _transport.FrameStream(sock,
+                                                 site="obs.watch")
+            _sync.send_frame(self.fs, {"op": "snap"})
+            reply = _transport.recv_msg(self.fs, self.read_timeout_s)
+        except (_s.CausalError, OSError) as e:
+            self.last_error = f"{type(e).__name__}: {e}"
+            self.close()
+            return None
+        if reply.get("op") != "snap":
+            self.last_error = f"unexpected reply op {reply.get('op')!r}"
+            return None
+        self.last_error = None
+        return reply.get("snapshot")
+
+    def close(self) -> None:
+        if self.fs is not None:
+            try:
+                self.fs.close()
+            except OSError:
+                pass
+            self.fs = None
+
+
 # --------------------------------------------------------------- CLI
 
 
@@ -347,9 +454,14 @@ def main(argv=None) -> int:
                     "ANSI-redraw dashboard, optional Prometheus "
                     "endpoint. --once renders a single snapshot and "
                     "exits (CI, cron, tunnel checks).")
-    ap.add_argument("jsonl", nargs="+",
+    ap.add_argument("jsonl", nargs="*",
                     help="obs event file(s) to tail (JSON lines; "
-                         "files may not exist yet in live mode)")
+                         "files may not exist yet in live mode). "
+                         "Not used with --collector.")
+    ap.add_argument("--collector", default=None, metavar="HOST:PORT",
+                    help="read the fleet-wide snapshot from a running "
+                         "CollectorServer's socket feed instead of "
+                         "tailing local files")
     ap.add_argument("--rules", action="append", default=None,
                     metavar="SPEC",
                     help="alert rule (repeatable): <path><op><value> "
@@ -376,6 +488,24 @@ def main(argv=None) -> int:
                     help="live mode: stop after this many seconds "
                          "(default: run until interrupted)")
     a = ap.parse_args(argv)
+
+    if a.collector is not None:
+        from .ship import parse_endpoint
+
+        if a.jsonl:
+            print("watch: give obs JSONL file(s) OR --collector, "
+                  "not both", file=sys.stderr)
+            return 2
+        ep = parse_endpoint(a.collector)
+        if ep is None:
+            print(f"watch: bad --collector endpoint: {a.collector!r} "
+                  "(want HOST:PORT)", file=sys.stderr)
+            return 2
+        return _collector_main(a, _CollectorFeed(*ep))
+    if not a.jsonl:
+        print("watch: give obs JSONL file(s) or --collector "
+              "HOST:PORT", file=sys.stderr)
+        return 2
 
     try:
         monitor = LiveMonitor(rules=a.rules)
@@ -457,6 +587,73 @@ def main(argv=None) -> int:
             sys.stdout.write("\n")
         except (OSError, ValueError):
             pass
+    return 0
+
+
+def _collector_main(a, feed: _CollectorFeed) -> int:
+    """The --collector loop: same dashboard, snapshots pulled from
+    the collector's socket feed (rules run collector-side — its
+    fleet-wide monitor already evaluated them; ``alerts_recent``
+    rides the snapshot)."""
+    label = [f"collector {feed.host}:{feed.port}"]
+    if a.once:
+        snap = feed.snap()
+        feed.close()
+        if snap is None:
+            print(f"watch: collector unreachable: {feed.last_error}",
+                  file=sys.stderr)
+            return 2
+        alerts = snap.get("alerts_recent") or []
+        if a.json:
+            print(json.dumps({"snapshot": snap, "alerts": alerts},
+                             default=str, indent=1))
+        else:
+            print(render(snap, alerts, label,
+                         clock=snap.get("ts_us", 0) / 1e6))
+        return 0
+    server = None
+    latest = {"snap": {}}
+    if a.serve_port is not None:
+        server, port = serve_metrics(a.serve_port,
+                                     lambda: latest["snap"])
+        print(f"watch: serving /metrics on 127.0.0.1:{port}",
+              file=sys.stderr)
+    deadline = (time.monotonic() + a.duration
+                if a.duration is not None else None)
+    first = True
+    try:
+        while True:
+            snap = feed.snap()
+            if snap is not None:
+                latest["snap"] = snap
+            alerts = (snap or latest["snap"]).get(
+                "alerts_recent") or []
+            if a.json:
+                print(json.dumps(
+                    {"snapshot": snap,
+                     "unreachable": feed.last_error}, default=str),
+                    flush=True)
+            elif snap is not None:
+                block = render(snap, alerts, label,
+                               clock=time.time())
+                prefix = _CLEAR if first else _HOME
+                sys.stdout.write(prefix + block + "\n" + _EOS)
+                sys.stdout.flush()
+                first = False
+            else:
+                sys.stdout.write(
+                    f"watch: collector unreachable "
+                    f"({feed.last_error}); retrying\n")
+                sys.stdout.flush()
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(max(0.05, a.interval))
+    except (KeyboardInterrupt, BrokenPipeError):
+        pass
+    finally:
+        feed.close()
+        if server is not None:
+            server.shutdown()
     return 0
 
 

@@ -108,11 +108,27 @@ class SyncService:
         self._watchdog_stop = threading.Event()
         self._watchdog_firing = False
         self._live = None
+        self._ship = None
         if obs.enabled():
             from ..obs import live as _live
 
             self._live = _live.attach(
                 on_alert=[self.controller.on_alert], source="serve")
+            # PR 20: the fleet telemetry uplink — every obs record
+            # this process mints ships to the collector named by
+            # CAUSE_TPU_OBS_SHIP ("host:port"). Best-effort by
+            # contract: an unreachable collector costs a bounded
+            # buffer + evidenced drops, never admission latency; an
+            # unparseable endpoint is ignored (the local sidecar
+            # still has everything).
+            endpoint = ship_mod = None
+            raw = os.environ.get("CAUSE_TPU_OBS_SHIP")
+            if raw:
+                from ..obs import ship as ship_mod
+
+                endpoint = ship_mod.parse_endpoint(raw)
+            if endpoint is not None:
+                self._ship = ship_mod.attach_exporter(*endpoint)
 
     # ------------------------------------------------------- tenants
 
@@ -415,6 +431,12 @@ class SyncService:
         if self._live is not None:
             self._live.close()
             self._live = None
+        if self._ship is not None:
+            # best-effort final flush then detach — whatever cannot
+            # ship in the bounded window is counted in
+            # stats["unshipped"], never waited on
+            self._ship.close()
+            self._ship = None
         if self.queue.tenant_known == self._knows_tenant:
             # a retired queue handle must not pin this service's whole
             # object graph (residency -> every tenant's device state)

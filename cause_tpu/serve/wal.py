@@ -218,7 +218,8 @@ class WriteAheadLog:
                       "gc_segments": 0, "gc_bytes": 0, "gc_aborts": 0}
         self._pending_fsync = 0
         self._last_fsync_s = time.monotonic()
-        self._read_manifest()
+        with self._lock:
+            self._read_manifest_locked()
         # resume: index every existing segment (seq continues past the
         # max on disk AND past the manifest's max — after a full GC
         # there may be no record left to scan, and reusing a retired
@@ -242,7 +243,8 @@ class WriteAheadLog:
         else:
             self._active = None
             self._open_active_locked(1)
-        self._gauges()
+        with self._lock:
+            self._gauges_locked()
 
     # -------------------------------------------------- construction
 
@@ -267,7 +269,7 @@ class WriteAheadLog:
                 "last_seq": last, "bytes": size,
                 "opened_s": time.monotonic()}
 
-    def _read_manifest(self) -> None:
+    def _read_manifest_locked(self) -> None:
         self._manifest_max_seq = 0
         p = os.path.join(self.path, WAL_MANIFEST_NAME)
         try:
@@ -316,13 +318,13 @@ class WriteAheadLog:
 
     # ------------------------------------------------------ evidence
 
-    def _disk_event(self, op: str, why: str) -> None:
+    def _disk_event_locked(self, op: str, why: str) -> None:
         if obs.enabled():
             obs.counter("serve.disk_faults").inc()
             obs.event("serve.disk", op=op, why=why, path=self.path,
                       segment=self._active["name"])
 
-    def _gauges(self) -> None:
+    def _gauges_locked(self) -> None:
         if obs.enabled():
             live = sum(sg["bytes"] for sg in self._index) \
                 + (self._active["bytes"] if self._active else 0)
@@ -358,7 +360,7 @@ class WriteAheadLog:
             if _chaos.enabled():
                 if _chaos.disk_enospc(_CHAOS_SITE):
                     self.stats["append_failures"] += 1
-                    self._disk_event("append", "enospc")
+                    self._disk_event_locked("append", "enospc")
                     raise s.CausalError(
                         "wal: append refused (no space left)",
                         {"causes": {"wal-enospc"}, "path": self.path})
@@ -371,7 +373,7 @@ class WriteAheadLog:
                     torn = body[: max(1, len(body) // 2)] + "\n"
                     self._write_locked(torn)
                     self.stats["append_failures"] += 1
-                    self._disk_event("append", "torn")
+                    self._disk_event_locked("append", "torn")
                     raise s.CausalError(
                         "wal: append torn (crash mid-write)",
                         {"causes": {"wal-torn"}, "path": self.path})
@@ -389,7 +391,7 @@ class WriteAheadLog:
                     raw = bytearray(body.encode("utf-8"))
                     raw[flip] ^= 0x01
                     body = raw.decode("latin-1")
-                    self._disk_event("append", "bitrot")
+                    self._disk_event_locked("append", "bitrot")
             self._write_locked(body + "\t#" + crc_hex + "\n")
             self._seq = seq
             a = self._active
@@ -398,7 +400,7 @@ class WriteAheadLog:
             a["last_seq"] = seq
             self.stats["appends"] += 1
             self._fsync_maybe_locked()
-            self._gauges()
+            self._gauges_locked()
         return seq
 
     def _write_locked(self, text: str) -> None:
@@ -440,7 +442,7 @@ class WriteAheadLog:
             self.stats["fsyncs"] += 1
         else:
             self.stats["fsync_failures"] += 1
-            self._disk_event("fsync", "fsync-failed")
+            self._disk_event_locked("fsync", "fsync-failed")
         self._pending_fsync = 0
         self._last_fsync_s = now if now is not None else time.monotonic()
         return ok
@@ -474,7 +476,7 @@ class WriteAheadLog:
         self._index.append(a)
         self.stats["rotations"] += 1
         self._open_active_locked(a["no"] + 1)
-        self._gauges()
+        self._gauges_locked()
 
     # ---------------------------------------------------------- scan
 
@@ -532,7 +534,7 @@ class WriteAheadLog:
                 # unadvanced, retried next cycle — evidenced, never
                 # silent
                 self.stats["gc_aborts"] += 1
-                self._disk_event("gc", "rename-failed")
+                self._disk_event_locked("gc", "rename-failed")
                 return {"retired": 0, "retired_bytes": 0,
                         "watermark": self.gc_watermark,
                         "aborted": True}
@@ -565,7 +567,7 @@ class WriteAheadLog:
             self.stats["gc_bytes"] += b
             if n:
                 self._write_manifest_locked()
-            self._gauges()
+            self._gauges_locked()
             return {"retired": n, "retired_bytes": b,
                     "watermark": self.gc_watermark, "aborted": False}
 
@@ -587,11 +589,14 @@ class WriteAheadLog:
 
     def wal_report(self) -> dict:
         with self._lock:
-            segments = len(self._index) + 1
-        return {"segments": segments, "live_bytes": self.dir_bytes(),
-                "appended_bytes": self.appended_bytes,
-                "gc_watermark": self.gc_watermark,
-                "fsync": self.fsync_policy, "stats": dict(self.stats)}
+            report = {"segments": len(self._index) + 1,
+                      "appended_bytes": self.appended_bytes,
+                      "gc_watermark": self.gc_watermark,
+                      "fsync": self.fsync_policy,
+                      "stats": dict(self.stats)}
+        # dir_bytes takes the lock itself — must stay outside it
+        report["live_bytes"] = self.dir_bytes()
+        return report
 
     def close(self) -> None:
         with self._lock:

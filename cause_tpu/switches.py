@@ -43,6 +43,7 @@ KNOWN_ENV_KNOBS = (
     "CAUSE_TPU_LAG_SLO_MS",
     "CAUSE_TPU_CHAOS",
     "CAUSE_TPU_WAL_FSYNC",
+    "CAUSE_TPU_OBS_SHIP",
 )
 
 # The XLA-only streaming candidate combination ("beststream"): the

@@ -1,0 +1,59 @@
+"""Known-bad SHP001 fixture: telemetry-shipping APIs on a traced
+path. Only the unguarded calls gate — guarded spellings are
+sanctioned, and generic verbs (``x.pump``/``x.flush``) on non-ship
+objects must never be flagged."""
+
+import jax
+
+from cause_tpu import obs
+from cause_tpu.obs import ship
+from cause_tpu.obs import ship as _ship
+
+
+@jax.jit
+def traced(x):
+    ship.attach_exporter("127.0.0.1", 9419)          # SHP001: unguarded
+    if obs.enabled():
+        exp = ship.ShipExporter(None, "127.0.0.1", 9419,
+                                start=False)         # guarded: fine
+        exp.pump()
+    return x * 2
+
+
+@jax.jit
+def traced_bare_name(x):
+    # distinctive bare names gate without a module qualifier too
+    from cause_tpu.obs.ship import attach_exporter
+
+    attach_exporter("127.0.0.1", 9419)               # SHP001: unguarded
+    return x + 1
+
+
+@jax.jit
+def traced_collector(x):
+    from cause_tpu.obs import collector as _collector
+
+    _collector.CollectorServer()                     # SHP001: unguarded
+    if obs.enabled():
+        _ship.ShipExporter(None, "127.0.0.1", 9419,
+                           start=False)              # guarded: fine
+    return x
+
+
+class _NotShip:
+    def pump(self):
+        return None
+
+    def flush(self):
+        return None
+
+
+@jax.jit
+def traced_generic_verbs_ok(x):
+    # pump()/flush() on an arbitrary object are NOT ship APIs — the
+    # rule matches the ship/collector qualifiers or distinctive
+    # class/factory names only
+    exp = _NotShip()
+    exp.pump()
+    exp.flush()
+    return x

@@ -280,6 +280,23 @@ def test_wal_unguarded_call_on_traced_path():
     assert rules_of(res) == ["DSK001"]
 
 
+def test_ship_unguarded_call_on_traced_path():
+    """SHP001 (PR 20): the telemetry-shipping layer spawns pump
+    threads, dials sockets and persists WAL segments when obs is on —
+    none of that may sit on a traced path unguarded. Exactly three
+    findings — the plain unguarded module-qualified factory, a
+    distinctive bare name, and the collector constructor under a
+    local alias; guarded spellings are sanctioned, and generic verbs
+    (pump/flush) on non-ship objects never flag."""
+    res = run_api(os.path.join(FIX, "ship_caller_bad.py"))
+    shp = [f for f in res.findings if f.rule == "SHP001"]
+    assert len(shp) == 3, [f.message for f in shp]
+    assert "attach_exporter" in shp[0].message
+    assert "attach_exporter" in shp[1].message
+    assert "CollectorServer" in shp[2].message
+    assert rules_of(res) == ["SHP001"]
+
+
 def test_lck_guard_bad_fixture():
     """LCK001 (PR 17), seeded historical bug: PR 12's boundary-reject
     stats — written under the lock in the spawning thread's loop,
@@ -515,7 +532,7 @@ def test_cli_exit_codes():
     "xtrace_caller_bad.py",
     "chaos_caller_bad.py", "serve_caller_bad.py",
     "batch_caller_bad.py", "net_caller_bad.py",
-    "wal_caller_bad.py", "lca_bad.py",
+    "wal_caller_bad.py", "ship_caller_bad.py", "lca_bad.py",
     "lck_guard_bad.py", "lck_watermark_bad.py", "lck_order_bad.py",
     "lck_block_bad.py", "lck_reentrant_bad.py", "dur_ack_bad.py",
     "dur_crashpoint_bad.py",
@@ -533,7 +550,8 @@ def test_cli_list_rules():
                 "OBS001", "OBS002", "OBS003", "OBS004", "OBS005",
                 "OBS006", "OBS007", "XTR001", "CHS001", "SRV001",
                 "NET001",
-                "DSK001", "LCA001", "GEN001", "LCK001", "LCK002",
+                "DSK001", "SHP001", "LCA001", "GEN001", "LCK001",
+                "LCK002",
                 "LCK003", "LCK004", "DUR001", "DUR002", "DUR003",
                 "DUR004", "EVD001"):
         assert rid in out.stdout
